@@ -1,0 +1,44 @@
+"""beforeholiday_tpu — a TPU-native mixed-precision & distributed-training framework.
+
+This package provides, natively on TPU (JAX / XLA / Pallas / shard_map), the
+capability surface of NVIDIA Apex (reference: /root/reference):
+
+- ``beforeholiday_tpu.amp``         — mixed-precision policy engine, opt levels O0–O5 with
+  dynamic loss scaling (ref: apex/amp/frontend.py:8-255).
+- ``beforeholiday_tpu.ops``         — Pallas kernel library: multi-tensor-apply family
+  (ref: csrc/multi_tensor_*.cu), fused LayerNorm/RMSNorm (ref: csrc/layer_norm_cuda_kernel.cu),
+  scaled-masked softmax family (ref: csrc/megatron/*softmax*.h), fused dense/MLP
+  (ref: csrc/fused_dense_cuda.cu, csrc/mlp_cuda.cu).
+- ``beforeholiday_tpu.optimizers``  — fused optimizers (ref: apex/optimizers/) and ZeRO-sharded
+  distributed optimizers (ref: apex/contrib/optimizers/distributed_fused_adam.py).
+- ``beforeholiday_tpu.parallel``    — data-parallel gradient reduction, SyncBatchNorm, LARC
+  (ref: apex/parallel/).
+- ``beforeholiday_tpu.transformer`` — Megatron-style tensor/sequence/pipeline parallelism on a
+  GSPMD mesh (ref: apex/transformer/).
+- ``beforeholiday_tpu.contrib``     — flash attention, fused losses, sparsity, etc.
+  (ref: apex/contrib/).
+
+Unlike the reference, which grafts CUDA kernels onto PyTorch via monkey-patching,
+this framework is functional and mesh-first: precision policies are dtype policies
+applied at trace time, multi-tensor kernels run over flat HBM arenas, and every
+collective is a `jax.lax` collective over named mesh axes carried on ICI/DCN.
+"""
+
+from beforeholiday_tpu import amp
+from beforeholiday_tpu import ops
+from beforeholiday_tpu import optimizers
+from beforeholiday_tpu import parallel
+from beforeholiday_tpu import transformer
+from beforeholiday_tpu.utils.logging import get_logger
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "amp",
+    "ops",
+    "optimizers",
+    "parallel",
+    "transformer",
+    "get_logger",
+    "__version__",
+]

@@ -1,0 +1,31 @@
+"""Data-parallel layer: mesh state, gradient reduction, SyncBatchNorm, LARC.
+
+TPU-native re-design of ``apex.parallel`` (ref: apex/parallel/__init__.py:9-17) and the
+mesh-building half of ``apex.transformer.parallel_state`` (ref:
+apex/transformer/parallel_state.py:81-682). NCCL process groups become named axes of one
+`jax.sharding.Mesh`; bucketed allreduce becomes `lax.psum` over the ``data`` axis.
+"""
+
+from beforeholiday_tpu.parallel import parallel_state
+from beforeholiday_tpu.parallel.parallel_state import (
+    initialize_model_parallel,
+    destroy_model_parallel,
+    model_parallel_is_initialized,
+    get_mesh,
+    DATA_AXIS,
+    TENSOR_AXIS,
+    PIPE_AXIS,
+    CONTEXT_AXIS,
+)
+
+__all__ = [
+    "parallel_state",
+    "initialize_model_parallel",
+    "destroy_model_parallel",
+    "model_parallel_is_initialized",
+    "get_mesh",
+    "DATA_AXIS",
+    "TENSOR_AXIS",
+    "PIPE_AXIS",
+    "CONTEXT_AXIS",
+]

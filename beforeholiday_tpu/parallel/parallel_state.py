@@ -1,0 +1,243 @@
+"""Global device-mesh state — the TPU equivalent of Megatron process groups.
+
+The reference builds DP/TP/PP/embedding NCCL process groups out of consecutive global
+ranks (ref: apex/transformer/parallel_state.py:81-311, ``initialize_model_parallel``).
+On TPU the same decomposition is ONE `jax.sharding.Mesh` with named axes: a process
+group is a mesh axis, a collective over a group is a `jax.lax` collective with
+``axis_name=``, and rank-within-group is `jax.lax.axis_index(axis)` inside
+`shard_map` (or implicit under GSPMD sharding propagation).
+
+Axis layout matches the reference's rank order (tensor fastest-varying →
+tensor-parallel peers are ICI-adjacent devices, exactly as apex places TP groups on
+consecutive GPUs, ref: parallel_state.py:214-233):
+
+    mesh shape = (pipe, data, context, tensor)
+
+``context`` is an extension beyond the reference (which has no CP, SURVEY.md §2.6):
+it carries ring-attention sequence sharding for long-context training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis names. Megatron sequence parallelism shards activations over the
+# SAME ranks as tensor parallelism (ref: apex/transformer/tensor_parallel/mappings.py:205-260),
+# so SP reuses TENSOR_AXIS; there is deliberately no separate "sequence" axis.
+DATA_AXIS = "data"
+TENSOR_AXIS = "tensor"
+PIPE_AXIS = "pipe"
+CONTEXT_AXIS = "context"
+
+MESH_AXIS_NAMES = (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelState:
+    """Immutable snapshot of the global parallel layout."""
+
+    mesh: Mesh
+    tensor_model_parallel_size: int
+    pipeline_model_parallel_size: int
+    data_parallel_size: int
+    context_parallel_size: int
+    virtual_pipeline_model_parallel_size: Optional[int]
+    pipeline_model_parallel_split_rank: Optional[int]
+
+
+_GLOBAL_STATE: Optional[ParallelState] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    *,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_split_rank: Optional[int] = None,
+    context_parallel_size: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> ParallelState:
+    """Build the global mesh (ref: apex/transformer/parallel_state.py:81-311).
+
+    Where the reference creates ``world_size // (tp*pp)`` data-parallel NCCL groups
+    etc., we construct one mesh of shape (pipe, data, context, tensor); every group
+    the reference materializes is recoverable as a mesh axis (or a product of axes —
+    the "model parallel" group is (pipe, tensor)).
+
+    Unlike the reference this is a pure function of the device list — calling it
+    again re-initializes (no "already initialized" assert), which suits tests.
+    """
+    if devices is None:
+        devices = jax.devices()
+    world = len(devices)
+    tp, pp, cp = tensor_model_parallel_size, pipeline_model_parallel_size, context_parallel_size
+    if world % (tp * pp * cp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tensor ({tp}) x "
+            f"pipeline ({pp}) x context ({cp}) parallel sizes"
+        )
+    dp = world // (tp * pp * cp)
+
+    if virtual_pipeline_model_parallel_size is not None and pp < 2:
+        raise RuntimeError(
+            "pipeline-model-parallel size should be greater than 1 with interleaved schedule"
+        )
+
+    dev_array = np.asarray(devices, dtype=object).reshape(pp, dp, cp, tp)
+    mesh = Mesh(dev_array, MESH_AXIS_NAMES)
+
+    global _GLOBAL_STATE
+    _GLOBAL_STATE = ParallelState(
+        mesh=mesh,
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        data_parallel_size=dp,
+        context_parallel_size=cp,
+        virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
+        pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank,
+    )
+    return _GLOBAL_STATE
+
+
+def destroy_model_parallel() -> None:
+    """Drop global state (ref: parallel_state.py:627-654 ``destroy_model_parallel``)."""
+    global _GLOBAL_STATE
+    _GLOBAL_STATE = None
+
+
+def model_parallel_is_initialized() -> bool:
+    """Ref: parallel_state.py:323 ``model_parallel_is_initialized``."""
+    return _GLOBAL_STATE is not None
+
+
+def _state() -> ParallelState:
+    if _GLOBAL_STATE is None:
+        raise RuntimeError(
+            "parallel state is not initialized — call initialize_model_parallel() first"
+        )
+    return _GLOBAL_STATE
+
+
+def get_state() -> ParallelState:
+    return _state()
+
+
+def get_mesh() -> Mesh:
+    return _state().mesh
+
+
+# --- world sizes (ref: parallel_state.py:389-420 get_*_world_size) ----------------
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _state().tensor_model_parallel_size
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _state().pipeline_model_parallel_size
+
+
+def get_data_parallel_world_size() -> int:
+    return _state().data_parallel_size
+
+
+def get_context_parallel_world_size() -> int:
+    return _state().context_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _state().virtual_pipeline_model_parallel_size
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _state().pipeline_model_parallel_split_rank
+
+
+# --- ranks --------------------------------------------------------------------------
+#
+# Under single-controller SPMD there is no per-process "my rank"; rank is a traced
+# per-device value available inside shard_map. These helpers return traced values
+# when the axis is bound and 0 otherwise (world size 1 on that axis behaves the
+# same way in the reference).
+
+
+def _axis_index_or_zero(axis: str):
+    try:
+        return jax.lax.axis_index(axis)
+    except NameError:
+        return 0
+
+
+def get_tensor_model_parallel_rank():
+    """Ref: parallel_state.py:425 ``get_tensor_model_parallel_rank``."""
+    return _axis_index_or_zero(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    """Ref: parallel_state.py:439 ``get_pipeline_model_parallel_rank``."""
+    return _axis_index_or_zero(PIPE_AXIS)
+
+
+def get_data_parallel_rank():
+    """Ref: parallel_state.py:575 ``get_data_parallel_rank``."""
+    return _axis_index_or_zero(DATA_AXIS)
+
+
+def get_context_parallel_rank():
+    return _axis_index_or_zero(CONTEXT_AXIS)
+
+
+def is_pipeline_first_stage():
+    """Traced predicate (ref: parallel_state.py:446 ``is_pipeline_first_stage``)."""
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage():
+    """Ref: parallel_state.py:458 ``is_pipeline_last_stage``."""
+    return get_pipeline_model_parallel_rank() == get_pipeline_model_parallel_world_size() - 1
+
+
+def get_pipeline_model_parallel_next_rank():
+    """Ref: parallel_state.py:594-608 pipeline prev/next helpers."""
+    pp = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() + 1) % pp
+
+
+def get_pipeline_model_parallel_prev_rank():
+    pp = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() - 1) % pp
+
+
+def get_rank_info():
+    """(data, tensor, pipe, context) rank tuple for log annotation.
+
+    Ref: parallel_state.py:313 ``get_rank_info`` feeding the RankInfoFormatter
+    (apex/__init__.py:27-39). Host-side we report process index; device-side ranks
+    are only meaningful inside shard_map.
+    """
+    if _GLOBAL_STATE is None:
+        return (0, 0, 0, 0)
+    return (
+        get_data_parallel_rank(),
+        get_tensor_model_parallel_rank(),
+        get_pipeline_model_parallel_rank(),
+        get_context_parallel_rank(),
+    )
+
+
+# --- sharding helpers ----------------------------------------------------------------
+
+
+def named_sharding(*spec) -> NamedSharding:
+    """NamedSharding over the global mesh from PartitionSpec entries."""
+    return NamedSharding(get_mesh(), PartitionSpec(*spec))
+
+
+def data_parallel_spec(ndim: int) -> PartitionSpec:
+    """Shard the leading (batch) dim over the data axis, replicate the rest."""
+    return PartitionSpec(DATA_AXIS, *([None] * (ndim - 1)))

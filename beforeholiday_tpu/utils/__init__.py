@@ -1,0 +1,6 @@
+"""Shared utilities: logging, pytree helpers, timers."""
+
+from beforeholiday_tpu.utils.logging import get_logger
+from beforeholiday_tpu.utils.timers import Timers
+
+__all__ = ["get_logger", "Timers"]

@@ -1,0 +1,46 @@
+"""Rank-annotated logging.
+
+The reference installs a ``RankInfoFormatter`` that prefixes every record with the
+(dp, tp, pp) rank tuple pulled from ``parallel_state.get_rank_info`` (ref:
+apex/__init__.py:27-39) and gates verbosity through an env var (ref:
+apex/transformer/log_util.py). Under single-controller JAX the meaningful host
+identity is `jax.process_index()`; device ranks are traced values, so we annotate
+with the process index and parallel layout sizes instead.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_LOG_ENV = "BEFOREHOLIDAY_TPU_LOG_LEVEL"
+
+
+class _ProcessInfoFormatter(logging.Formatter):
+    """Prefixes records with the JAX process index (multi-host) and layout."""
+
+    def format(self, record):
+        try:
+            import jax
+
+            proc = jax.process_index()
+            nprocs = jax.process_count()
+        except Exception:
+            proc, nprocs = 0, 1
+        record.rankinfo = f"p{proc}/{nprocs}"
+        return super().format(record)
+
+
+def get_logger(name: str = "beforeholiday_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            _ProcessInfoFormatter(
+                "%(asctime)s [%(rankinfo)s] %(levelname)s %(name)s: %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get(_LOG_ENV, "WARNING").upper())
+        logger.propagate = False
+    return logger
