@@ -1,0 +1,20 @@
+"""Fused TPU kernels — the Pallas equivalent of apex's ``csrc`` extensions.
+
+* ``arena`` — flatten/unflatten tensor lists into flat HBM arenas (``apex_C``).
+* ``multi_tensor`` — the multi-tensor-apply family (``amp_C``): scale, axpby,
+  l2norm, adam, sgd, lamb, novograd, adagrad, lars, with device-side overflow
+  semantics.
+"""
+
+from .arena import ArenaSpec, flatten, make_spec, unflatten  # noqa: F401
+from .multi_tensor import (  # noqa: F401
+    multi_tensor_adagrad,
+    multi_tensor_adam,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_lamb,
+    multi_tensor_lars,
+    multi_tensor_novograd,
+    multi_tensor_scale,
+    multi_tensor_sgd,
+)

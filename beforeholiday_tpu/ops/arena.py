@@ -1,0 +1,128 @@
+"""Tensor-list arena — the TPU equivalent of ``apex_C`` flatten/unflatten.
+
+The reference packs up to 110 raw CUDA pointers per kernel launch
+(ref: csrc/multi_tensor_apply.cuh:16-26, ``TensorListMetadata``) and exposes
+``apex_C.flatten``/``unflatten`` (ref: csrc/flatten_unflatten.cpp:1-18) for DDP
+bucketing. Pointer lists do not exist under XLA; the TPU-native design (SURVEY.md
+§7 "hard parts") is a *flat HBM arena*: every tensor list is flattened once into a
+single 1D buffer padded to the TPU lane/sublane tiling, and every multi-tensor
+kernel runs over the arena with one grid. Per-tensor boundaries are kept as a
+*static* offset table (shapes are static under jit), so unflattening is a set of
+slices XLA fuses into consumers.
+
+Views of one flat buffer also make ZeRO-style sharding trivial: shard the arena
+itself over the ``data`` axis (ref: apex/contrib/optimizers/distributed_fused_adam.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# TPU native tiling: last dim is always 128 lanes; fp32 sublane is 8.
+# Pad every arena to a multiple of the multi-tensor kernel block (256 rows x 128
+# lanes = 32768 elements) so the Pallas grid needs no remainder handling — the
+# reference's chunk size 2048*32 plays the same role
+# (csrc/multi_tensor_apply.cuh:44-58). Worst-case waste is 128 KiB fp32.
+LANES = 128
+SUBLANES = 8
+TILE = 256 * LANES  # one kernel block
+
+
+@dataclasses.dataclass(frozen=True)
+class ArenaSpec:
+    """Static metadata describing how a tensor list is packed into a flat buffer."""
+
+    shapes: Tuple[Tuple[int, ...], ...]
+    offsets: Tuple[int, ...]  # start offset of each tensor in the flat buffer
+    total: int  # sum of tensor sizes (unpadded)
+    padded_total: int  # total rounded up to a TILE multiple
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.shapes)
+
+    def segment_ids(self) -> np.ndarray:
+        """int32[padded_total] mapping every arena element to its tensor index.
+
+        Padding elements map to ``num_tensors`` (an extra, discarded segment) so
+        per-tensor reductions (LAMB/LARS/NovoGrad trust ratios, per-tensor
+        l2norm — ref: csrc/multi_tensor_l2norm_kernel.cu per-tensor outputs) are
+        one ``segment_sum`` over the arena.
+        """
+        ids = np.full((self.padded_total,), self.num_tensors, dtype=np.int32)
+        for i, (off, shape) in enumerate(zip(self.offsets, self.shapes)):
+            n = int(np.prod(shape)) if shape else 1
+            ids[off : off + n] = i
+        return ids
+
+
+def make_spec(tensors: Sequence[jax.Array]) -> ArenaSpec:
+    shapes = tuple(tuple(t.shape) for t in tensors)
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    offsets = tuple(int(x) for x in np.cumsum([0] + sizes[:-1]))
+    total = int(sum(sizes))
+    padded_total = ((total + TILE - 1) // TILE) * TILE if total else TILE
+    return ArenaSpec(shapes=shapes, offsets=offsets, total=total, padded_total=padded_total)
+
+
+def flatten(tensors: Sequence[jax.Array], dtype=None) -> Tuple[jax.Array, ArenaSpec]:
+    """Pack a tensor list into one flat padded 1D buffer.
+
+    TPU analogue of ``apex_C.flatten`` (ref: csrc/flatten_unflatten.cpp:6-9).
+    All tensors must share a dtype unless ``dtype`` forces a cast — the reference
+    likewise buckets by dtype before flattening (apex/parallel/distributed.py:241-244).
+    """
+    if not tensors:
+        raise ValueError("flatten() requires a non-empty tensor list")
+    spec = make_spec(tensors)
+    if dtype is None:
+        dtype = tensors[0].dtype
+        for t in tensors:
+            if t.dtype != dtype:
+                raise ValueError(
+                    f"mixed dtypes in arena ({t.dtype} vs {dtype}); bucket by dtype "
+                    "first (ref: apex/parallel/distributed.py:241-244) or pass dtype="
+                )
+    flat = jnp.concatenate([jnp.ravel(t).astype(dtype) for t in tensors])
+    pad = spec.padded_total - spec.total
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype=dtype)])
+    return flat, spec
+
+
+def unflatten(flat: jax.Array, spec: ArenaSpec, dtype=None) -> List[jax.Array]:
+    """Slice a flat arena back into the original tensor list.
+
+    TPU analogue of ``apex_C.unflatten`` (ref: csrc/flatten_unflatten.cpp:11-14).
+    Slices are static, so XLA fuses them into consumers — no materialized copy.
+    """
+    out = []
+    for off, shape in zip(spec.offsets, spec.shapes):
+        n = int(np.prod(shape)) if shape else 1
+        piece = jax.lax.dynamic_slice_in_dim(flat, off, n).reshape(shape)
+        if dtype is not None:
+            piece = piece.astype(dtype)
+        out.append(piece)
+    return out
+
+
+def tree_flatten_arena(tree: Any, dtype=None):
+    """Flatten an arbitrary pytree of arrays into (arena, spec, treedef)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    flat, spec = flatten(leaves, dtype=dtype)
+    return flat, spec, treedef
+
+
+def tree_unflatten_arena(flat: jax.Array, spec: ArenaSpec, treedef, dtype=None):
+    return jax.tree_util.tree_unflatten(treedef, unflatten(flat, spec, dtype=dtype))
+
+
+def as_rows(flat: jax.Array) -> jax.Array:
+    """View a padded flat arena as (rows, LANES) for lane-aligned kernels."""
+    assert flat.shape[0] % LANES == 0, "arena must be padded to LANES"
+    return flat.reshape(-1, LANES)

@@ -1,0 +1,484 @@
+"""Multi-tensor-apply public API — functional TPU port of ``amp_C``.
+
+The reference mutates tensor lists in place through one fused CUDA launch per op
+(ref: csrc/amp_C_frontend.cpp:166-193). JAX is functional, so every op here
+*returns* the updated lists plus (where the reference uses the ``noop_flag``
+buffer) a traced ``found_inf`` boolean that callers thread through
+``lax.cond``/``where`` — the device-side skip-step semantics of
+apex/amp/scaler.py:114-126 without host syncs.
+
+Every op has two implementations with identical fp32 math:
+
+* ``impl="pallas"`` — the arena kernels in ``_pallas_mt.py`` (native on TPU,
+  interpreter elsewhere);
+* ``impl="jnp"`` — straight-line jnp, used as the parity oracle (the same role
+  torch eager math plays in tests/L0/run_amp/test_multi_tensor_scale.py) and as
+  the default off-TPU.
+
+Per-tensor reductions (l2norm per_tensor, LAMB trust ratios, NovoGrad moments)
+use ``jax.ops.segment_sum`` over a static segment-id table instead of the
+reference's per-tensor CUDA blocks — offsets are static under jit, so XLA lowers
+this to an efficient one-pass reduction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import _pallas_mt as k
+from .arena import ArenaSpec, flatten, make_spec, unflatten
+
+
+def _default_impl() -> str:
+    return "pallas" if jax.default_backend() == "tpu" else "jnp"
+
+
+def _resolve(impl: Optional[str]) -> str:
+    impl = impl or _default_impl()
+    if impl not in ("pallas", "jnp"):
+        raise ValueError(f"impl must be 'pallas' or 'jnp', got {impl!r}")
+    return impl
+
+
+def _interp(impl: str):
+    # pallas impl off-TPU runs the interpreter
+    return None
+
+
+def _nonfinite_any(x) -> jax.Array:
+    return jnp.any(~jnp.isfinite(x))
+
+
+def _segment_coef(values_per_tensor: jax.Array, spec: ArenaSpec) -> jax.Array:
+    """Gather a per-tensor value to a per-element arena vector (static table)."""
+    seg = jnp.asarray(spec.segment_ids())
+    padded = jnp.concatenate([values_per_tensor, jnp.zeros((1,), values_per_tensor.dtype)])
+    return padded[seg]
+
+
+def per_tensor_sumsq(flat: jax.Array, spec: ArenaSpec) -> jax.Array:
+    """Per-tensor sum of squares over the arena (ref: per-tensor l2norm outputs)."""
+    seg = jnp.asarray(spec.segment_ids())
+    x = flat.astype(jnp.float32)
+    sums = jax.ops.segment_sum(x * x, seg, num_segments=spec.num_tensors + 1)
+    return sums[:-1]
+
+
+# ---------------------------------------------------------------------------------
+# multi_tensor_scale (ref: csrc/multi_tensor_scale_kernel.cu via amp_C_frontend.cpp:168)
+# ---------------------------------------------------------------------------------
+
+
+def multi_tensor_scale(
+    src: Sequence[jax.Array],
+    scale,
+    *,
+    out_dtype=None,
+    impl: Optional[str] = None,
+) -> Tuple[List[jax.Array], jax.Array]:
+    """out[i] = src[i] * scale. Returns (outs, found_inf).
+
+    found_inf mirrors the reference's noop_flag: set when any input/output
+    element is non-finite (amp unscale overflow detection, apex/amp/scaler.py:114-126).
+    """
+    impl = _resolve(impl)
+    flat, spec = flatten(src)
+    out_dtype = out_dtype or flat.dtype
+    if impl == "pallas":
+        out, flag = k.scale(flat, scale, out_dtype)
+    else:
+        y = flat.astype(jnp.float32) * scale
+        flag = _nonfinite_any(flat) | _nonfinite_any(y)
+        out = y.astype(out_dtype)
+    return unflatten(out, spec), flag
+
+
+# ---------------------------------------------------------------------------------
+# multi_tensor_axpby (ref: csrc/multi_tensor_axpby_kernel.cu)
+# ---------------------------------------------------------------------------------
+
+
+def multi_tensor_axpby(
+    x: Sequence[jax.Array],
+    y: Sequence[jax.Array],
+    a,
+    b,
+    *,
+    out_dtype=None,
+    arg_to_check: int = -1,
+    impl: Optional[str] = None,
+) -> Tuple[List[jax.Array], jax.Array]:
+    """out = a*x + b*y with overflow check on x (0), y (1), or both (-1)."""
+    impl = _resolve(impl)
+    xf, spec = flatten(x)
+    yf, _ = flatten(y)
+    out_dtype = out_dtype or xf.dtype
+    if impl == "pallas":
+        out, flag = k.axpby(xf, yf, a, b, out_dtype, arg_to_check=arg_to_check)
+    else:
+        x32, y32 = xf.astype(jnp.float32), yf.astype(jnp.float32)
+        out = (a * x32 + b * y32).astype(out_dtype)
+        if arg_to_check == -1:
+            flag = _nonfinite_any(x32) | _nonfinite_any(y32)
+        elif arg_to_check == 0:
+            flag = _nonfinite_any(x32)
+        else:
+            flag = _nonfinite_any(y32)
+    return unflatten(out, spec), flag
+
+
+# ---------------------------------------------------------------------------------
+# multi_tensor_l2norm (+ per_tensor) (ref: csrc/multi_tensor_l2norm_kernel.cu)
+# ---------------------------------------------------------------------------------
+
+
+def multi_tensor_l2norm(
+    tensors: Sequence[jax.Array],
+    *,
+    per_tensor: bool = False,
+    impl: Optional[str] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Global (and optionally per-tensor) L2 norm of a tensor list."""
+    impl = _resolve(impl)
+    flat, spec = flatten(tensors)
+    if impl == "pallas":
+        sq, _ = k.l2norm_sq(flat)
+    else:
+        x = flat.astype(jnp.float32)
+        sq = jnp.sum(x * x)
+    norm = jnp.sqrt(sq)
+    if per_tensor:
+        return norm, jnp.sqrt(per_tensor_sumsq(flat, spec))
+    return norm, None
+
+
+# ---------------------------------------------------------------------------------
+# multi_tensor_adam (ref: csrc/multi_tensor_adam.cu)
+# ---------------------------------------------------------------------------------
+
+
+def _bias_corrections(bias_correction: bool, step, beta1: float, beta2: float):
+    if bias_correction:
+        step = jnp.asarray(step, jnp.float32)
+        return 1.0 - beta1**step, 1.0 - beta2**step
+    return jnp.float32(1.0), jnp.float32(1.0)
+
+
+def multi_tensor_adam(
+    grads: Sequence[jax.Array],
+    params: Sequence[jax.Array],
+    exp_avgs: Sequence[jax.Array],
+    exp_avg_sqs: Sequence[jax.Array],
+    *,
+    lr,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    eps: float = 1e-8,
+    step=1,
+    adam_w_mode: bool = True,
+    bias_correction: bool = True,
+    weight_decay: float = 0.0,
+    grad_scale=1.0,
+    found_inf=None,
+    impl: Optional[str] = None,
+):
+    """Fused Adam/AdamW over a tensor list. Returns (params, m, v) updated.
+
+    ``found_inf`` (traced bool/0-1 scalar) turns the whole update into identity —
+    the reference's device-side noop/skip-step (csrc/multi_tensor_apply.cuh noop_gmem,
+    apex/amp/handle.py:127-154).
+    """
+    impl = _resolve(impl)
+    bc1, bc2 = _bias_corrections(bias_correction, step, beta1, beta2)
+    gf, spec = flatten(grads)
+    pf, _ = flatten(params)
+    mf, _ = flatten(exp_avgs)
+    vf, _ = flatten(exp_avg_sqs)
+    if impl == "pallas":
+        p_new, m_new, v_new = k.adam(
+            gf, pf, mf, vf,
+            lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+            bias_correction1=bc1, bias_correction2=bc2,
+            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+            grad_scale=grad_scale, found_inf=found_inf,
+        )
+    else:
+        g = gf.astype(jnp.float32) * grad_scale
+        p, m, v = pf.astype(jnp.float32), mf.astype(jnp.float32), vf.astype(jnp.float32)
+        if not adam_w_mode:
+            g = g + weight_decay * p
+        m_new = beta1 * m + (1.0 - beta1) * g
+        v_new = beta2 * v + (1.0 - beta2) * g * g
+        update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        if adam_w_mode:
+            update = update + weight_decay * p
+        p_new = p - lr * update
+        if found_inf is not None:
+            skip = jnp.asarray(found_inf) != 0
+            p_new = jnp.where(skip, p, p_new)
+            m_new = jnp.where(skip, m, m_new)
+            v_new = jnp.where(skip, v, v_new)
+        p_new = p_new.astype(pf.dtype)
+        m_new = m_new.astype(mf.dtype)
+        v_new = v_new.astype(vf.dtype)
+    return unflatten(p_new, spec), unflatten(m_new, spec), unflatten(v_new, spec)
+
+
+# ---------------------------------------------------------------------------------
+# multi_tensor_adagrad (ref: csrc/multi_tensor_adagrad.cu)
+# ---------------------------------------------------------------------------------
+
+
+def multi_tensor_adagrad(
+    grads, params, state_sums, *, lr, eps: float = 1e-10, weight_decay: float = 0.0,
+    mode: int = 0, found_inf=None, impl: Optional[str] = None,
+):
+    impl = _resolve(impl)
+    gf, spec = flatten(grads)
+    pf, _ = flatten(params)
+    hf, _ = flatten(state_sums)
+    if impl == "pallas":
+        p_new, h_new = k.adagrad(
+            gf, pf, hf, lr=lr, eps=eps, weight_decay=weight_decay, mode=mode,
+            found_inf=found_inf,
+        )
+    else:
+        g, p, h = gf.astype(jnp.float32), pf.astype(jnp.float32), hf.astype(jnp.float32)
+        if mode == 0:
+            g = g + weight_decay * p
+            h_new = h + g * g
+            p_new = p - lr * (g / (jnp.sqrt(h_new) + eps))
+        else:
+            h_new = h + g * g
+            p_new = p - lr * (g / (jnp.sqrt(h_new) + eps) + weight_decay * p)
+        if found_inf is not None:
+            skip = jnp.asarray(found_inf) != 0
+            p_new = jnp.where(skip, p, p_new)
+            h_new = jnp.where(skip, h, h_new)
+        p_new, h_new = p_new.astype(pf.dtype), h_new.astype(hf.dtype)
+    return unflatten(p_new, spec), unflatten(h_new, spec)
+
+
+# ---------------------------------------------------------------------------------
+# multi_tensor_sgd (ref: csrc/multi_tensor_sgd_kernel.cu)
+# ---------------------------------------------------------------------------------
+
+
+def multi_tensor_sgd(
+    grads, params, momentums, *, lr, weight_decay: float = 0.0, momentum: float = 0.0,
+    dampening: float = 0.0, nesterov: bool = False, first_run: bool = False,
+    wd_after_momentum: bool = False, scale: float = 1.0,
+    model_copy_dtype=None, found_inf=None, impl: Optional[str] = None,
+):
+    """Fused SGD. Returns (params, momentums[, model_copies]).
+
+    ``model_copy_dtype`` reproduces the reference's 4-list variant that also
+    writes a half-precision model-weight copy for amp O2 master weights
+    (ref: multi_tensor_sgd_kernel.cu:61-130)."""
+    impl = _resolve(impl)
+    gf, spec = flatten(grads)
+    pf, _ = flatten(params)
+    mf, _ = flatten(momentums)
+    if impl == "pallas":
+        outs = k.sgd(
+            gf, pf, mf, lr=lr, weight_decay=weight_decay, momentum=momentum,
+            dampening=dampening, nesterov=nesterov, first_run=first_run,
+            wd_after_momentum=wd_after_momentum, scale=scale,
+            model_copy_dtype=model_copy_dtype, found_inf=found_inf,
+        )
+    else:
+        g = gf.astype(jnp.float32) * scale
+        p, mom = pf.astype(jnp.float32), mf.astype(jnp.float32)
+        if not wd_after_momentum:
+            g = g + weight_decay * p
+        if momentum != 0.0:
+            mom_new = g if first_run else mom * momentum + (1.0 - dampening) * g
+            step = g + momentum * mom_new if nesterov else mom_new
+        else:
+            mom_new, step = mom, g
+        if wd_after_momentum:
+            step = step + weight_decay * p
+        p_new = p - lr * step
+        if found_inf is not None:
+            skip = jnp.asarray(found_inf) != 0
+            p_new = jnp.where(skip, p, p_new)
+            mom_new = jnp.where(skip, mom, mom_new)
+        outs = [p_new.astype(pf.dtype), mom_new.astype(mf.dtype)]
+        if model_copy_dtype is not None:
+            outs.append(p_new.astype(model_copy_dtype))
+    result = [unflatten(o, spec) for o in outs]
+    return tuple(result)
+
+
+# ---------------------------------------------------------------------------------
+# multi_tensor_novograd (ref: csrc/multi_tensor_novograd.cu)
+# ---------------------------------------------------------------------------------
+
+
+def multi_tensor_novograd(
+    grads, params, exp_avgs, grad_norms: jax.Array, *, lr, beta1: float = 0.95,
+    beta2: float = 0.98, eps: float = 1e-8, step=1, bias_correction: bool = True,
+    weight_decay: float = 0.0, grad_averaging: bool = True, moment_mode: int = 0,
+    found_inf=None, impl: Optional[str] = None,
+):
+    """Fused NovoGrad. ``grad_norms`` is the per-tensor second-moment state v_t
+    (one scalar per tensor). Returns (params, m, new_grad_norms).
+
+    Per the reference launcher: v_t = beta2*v + (1-beta2)*||g||^2 on step>1,
+    ||g||^2 on step 1; denom = sqrt(v_t)/bc2 + eps (bc2 = sqrt(1-beta2^t)).
+    """
+    impl = _resolve(impl)
+    gf, spec = flatten(grads)
+    pf, _ = flatten(params)
+    mf, _ = flatten(exp_avgs)
+
+    step_f = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - beta1**step_f
+        bc2 = jnp.sqrt(1.0 - beta2**step_f)
+    else:
+        bc1 = jnp.float32(1.0)
+        bc2 = jnp.float32(1.0)
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+
+    # update per-tensor second moment from this step's per-tensor grad norms
+    gnorm_sq = per_tensor_sumsq(gf, spec)
+    v_new = jnp.where(step_f <= 1.0, gnorm_sq, beta2 * grad_norms + (1.0 - beta2) * gnorm_sq)
+    denom_pt = jnp.sqrt(v_new) / bc2 + eps
+    denom = _segment_coef(denom_pt, spec)
+
+    if impl == "pallas":
+        p_new, m_new = k.novograd_ew(
+            gf, pf, mf, denom, beta1=beta1, beta3=beta3, bias_correction1=bc1,
+            lr=lr, weight_decay=weight_decay, mode=moment_mode, found_inf=found_inf,
+        )
+    else:
+        g, p, m = gf.astype(jnp.float32), pf.astype(jnp.float32), mf.astype(jnp.float32)
+        if moment_mode == 0:
+            gp = g / denom + weight_decay * p
+            m_new = beta1 * m + beta3 * gp
+            p_new = p - lr * (m_new / bc1)
+        else:
+            m_new = beta1 * m + beta3 * g
+            p_new = p - lr * ((m_new / bc1) / denom + weight_decay * p)
+        if found_inf is not None:
+            skip = jnp.asarray(found_inf) != 0
+            p_new = jnp.where(skip, p, p_new)
+            m_new = jnp.where(skip, m, m_new)
+        p_new, m_new = p_new.astype(pf.dtype), m_new.astype(mf.dtype)
+    return unflatten(p_new, spec), unflatten(m_new, spec), v_new
+
+
+# ---------------------------------------------------------------------------------
+# multi_tensor_lamb (ref: csrc/multi_tensor_lamb.cu — stage1 + per-tensor norms +
+# stage2 trust-ratio application)
+# ---------------------------------------------------------------------------------
+
+
+def multi_tensor_lamb(
+    grads, params, exp_avgs, exp_avg_sqs, *, lr, beta1: float = 0.9,
+    beta2: float = 0.999, eps: float = 1e-6, step=1, bias_correction: bool = True,
+    weight_decay: float = 0.0, grad_averaging: bool = True, mode: int = 1,
+    global_grad_norm=None, max_grad_norm: float = 1.0, use_nvlamb: bool = False,
+    found_inf=None, impl: Optional[str] = None,
+):
+    """Fused LAMB. Returns (params, m, v).
+
+    Stage 1 computes the Adam-style update; per-tensor ``||p||``/``||u||`` trust
+    ratios then rescale the lr per tensor (nvlamb: for every tensor; otherwise
+    only tensors with weight decay — ref: multi_tensor_lamb.cu:255-263).
+    """
+    impl = _resolve(impl)
+    gf, spec = flatten(grads)
+    pf, _ = flatten(params)
+    mf, _ = flatten(exp_avgs)
+    vf, _ = flatten(exp_avg_sqs)
+
+    bc1, bc2 = _bias_corrections(bias_correction, step, beta1, beta2)
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+    if global_grad_norm is None:
+        global_grad_norm = jnp.sqrt(jnp.sum(gf.astype(jnp.float32) ** 2))
+    clipped = jnp.where(
+        global_grad_norm > max_grad_norm, global_grad_norm / max_grad_norm, 1.0
+    )
+
+    g32, p32 = gf.astype(jnp.float32), pf.astype(jnp.float32)
+    if impl == "pallas":
+        u, m_new, v_new = k.lamb_stage1(
+            gf, pf, mf, vf, beta1=beta1, beta2=beta2, beta3=beta3,
+            bias_correction1=bc1, bias_correction2=bc2, eps=eps,
+            weight_decay=weight_decay, clipped_global_grad_norm=clipped, mode=mode,
+        )
+    else:
+        m, v = mf.astype(jnp.float32), vf.astype(jnp.float32)
+        sg = g32 / clipped
+        if mode == 0:
+            sg = sg + weight_decay * p32
+        m_new = m * beta1 + beta3 * sg
+        v_new = v * beta2 + (1.0 - beta2) * sg * sg
+        u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        if mode == 1:
+            u = u + weight_decay * p32
+        m_new, v_new = m_new.astype(mf.dtype), v_new.astype(vf.dtype)
+
+    # per-tensor trust ratios (stage 2)
+    p_norm = jnp.sqrt(per_tensor_sumsq(pf, spec))
+    u_norm = jnp.sqrt(per_tensor_sumsq(u, spec))
+    apply_ratio = use_nvlamb or (weight_decay != 0.0)
+    if apply_ratio:
+        ratio_pt = jnp.where(
+            (p_norm != 0.0) & (u_norm != 0.0), lr * (p_norm / u_norm), lr
+        )
+    else:
+        ratio_pt = jnp.full_like(p_norm, lr)
+    coef = _segment_coef(ratio_pt, spec)
+
+    if impl == "pallas":
+        p_new = k.apply_scaled_update(pf, u, coef, found_inf=found_inf)
+    else:
+        p_new = p32 - coef * u
+        if found_inf is not None:
+            p_new = jnp.where(jnp.asarray(found_inf) != 0, p32, p_new)
+        p_new = p_new.astype(pf.dtype)
+    return unflatten(p_new, spec), unflatten(m_new, spec), unflatten(v_new, spec)
+
+
+# ---------------------------------------------------------------------------------
+# multi_tensor_lars (ref: csrc/multi_tensor_lars.cu — layer-wise adaptive rate)
+# ---------------------------------------------------------------------------------
+
+
+def multi_tensor_lars(
+    grads, params, momentums, *, lr, trust_coefficient: float = 0.001,
+    epsilon: float = 0.0, weight_decay: float = 0.0, momentum: float = 0.0,
+    dampening: float = 0.0, nesterov: bool = False, first_run: bool = False,
+    wd_after_momentum: bool = False, scale: float = 1.0,
+    found_inf=None, impl: Optional[str] = None,
+):
+    """Fused LARS: per-tensor trust-ratio-scaled lr feeding the SGD update
+    (ref: csrc/multi_tensor_lars.cu; apex/parallel/LARC.py:79-94 trust math)."""
+    impl = _resolve(impl)
+    gf, spec = flatten(grads)
+    pf, _ = flatten(params)
+
+    g_norm = jnp.sqrt(per_tensor_sumsq(gf, spec)) * scale
+    p_norm = jnp.sqrt(per_tensor_sumsq(pf, spec))
+    trust = jnp.where(
+        (g_norm != 0.0) & (p_norm != 0.0),
+        trust_coefficient * p_norm / (g_norm + weight_decay * p_norm + epsilon),
+        1.0,
+    )
+    # fold the per-tensor adaptive rate into the gradient, then run fused SGD
+    coef = _segment_coef(trust, spec)
+    scaled_g = unflatten((gf.astype(jnp.float32) * coef).astype(gf.dtype), spec)
+    return multi_tensor_sgd(
+        scaled_g, params, momentums, lr=lr, weight_decay=weight_decay,
+        momentum=momentum, dampening=dampening, nesterov=nesterov,
+        first_run=first_run, wd_after_momentum=wd_after_momentum, scale=scale,
+        found_inf=found_inf, impl=impl,
+    )

@@ -1,0 +1,272 @@
+"""Kernel-vs-reference parity for the multi-tensor family.
+
+Port of the reference's kernel-equivalence suite
+(tests/L0/run_amp/test_multi_tensor_scale.py, test_multi_tensor_axpby.py,
+test_multi_tensor_l2norm.py), including inf/nan injection for the overflow flag.
+The pallas implementation (interpreted on the CPU test platform) is compared
+against the jnp oracle and against torch reference math where apex's own tests
+do the same.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beforeholiday_tpu.ops import arena
+from beforeholiday_tpu.ops import multi_tensor as mt
+
+
+def _rand_lists(shapes, dtype=jnp.float32, seed=0, n_lists=1):
+    rng = np.random.RandomState(seed)
+    out = []
+    for j in range(n_lists):
+        out.append(
+            [jnp.asarray(rng.randn(*s).astype(np.float32), dtype=dtype) for s in shapes]
+        )
+    return out if n_lists > 1 else out[0]
+
+
+SHAPES = [(7,), (33, 5), (128,), (3, 4, 9)]
+
+
+class TestArena:
+    def test_roundtrip(self):
+        ts = _rand_lists(SHAPES)
+        flat, spec = arena.flatten(ts)
+        assert flat.shape[0] % arena.TILE == 0
+        back = arena.unflatten(flat, spec)
+        for a, b in zip(ts, back):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_segment_ids(self):
+        ts = _rand_lists(SHAPES)
+        _, spec = arena.flatten(ts)
+        seg = spec.segment_ids()
+        sizes = [int(np.prod(s)) for s in SHAPES]
+        assert (seg[: sizes[0]] == 0).all()
+        assert (seg[spec.total :] == len(SHAPES)).all()
+
+    def test_mixed_dtype_rejected(self):
+        with pytest.raises(ValueError):
+            arena.flatten([jnp.zeros(3, jnp.float32), jnp.zeros(3, jnp.bfloat16)])
+
+
+@pytest.mark.parametrize("impl", ["jnp", "pallas"])
+class TestScaleAxpby:
+    def test_scale(self, impl):
+        ts = _rand_lists(SHAPES)
+        outs, flag = mt.multi_tensor_scale(ts, 0.5, impl=impl)
+        for a, b in zip(ts, outs):
+            np.testing.assert_allclose(np.asarray(a) * 0.5, np.asarray(b), rtol=1e-6)
+        assert not bool(flag)
+
+    @pytest.mark.parametrize("bad", [np.inf, -np.inf, np.nan])
+    def test_scale_overflow(self, impl, bad):
+        # inf/nan injection, as in tests/L0/run_amp/test_multi_tensor_scale.py
+        ts = _rand_lists(SHAPES)
+        poisoned = list(ts)
+        arr = np.asarray(poisoned[2]).copy()
+        arr[-1] = bad
+        poisoned[2] = jnp.asarray(arr)
+        _, flag = mt.multi_tensor_scale(poisoned, 2.0, impl=impl)
+        assert bool(flag)
+
+    def test_scale_downcast(self, impl):
+        ts = _rand_lists(SHAPES)
+        outs, _ = mt.multi_tensor_scale(ts, 2.0, out_dtype=jnp.bfloat16, impl=impl)
+        assert all(o.dtype == jnp.bfloat16 for o in outs)
+
+    def test_axpby(self, impl):
+        xs, ys = _rand_lists(SHAPES, n_lists=2)
+        outs, flag = mt.multi_tensor_axpby(xs, ys, 2.0, -3.0, impl=impl)
+        for x, y, o in zip(xs, ys, outs):
+            np.testing.assert_allclose(
+                2.0 * np.asarray(x) - 3.0 * np.asarray(y), np.asarray(o), rtol=1e-6
+            )
+        assert not bool(flag)
+
+    def test_axpby_check_arg(self, impl):
+        xs, ys = _rand_lists(SHAPES, n_lists=2)
+        arr = np.asarray(ys[0]).copy()
+        arr.flat[0] = np.nan
+        ys[0] = jnp.asarray(arr)
+        _, flag_both = mt.multi_tensor_axpby(xs, ys, 1.0, 1.0, arg_to_check=-1, impl=impl)
+        _, flag_x = mt.multi_tensor_axpby(xs, ys, 1.0, 1.0, arg_to_check=0, impl=impl)
+        _, flag_y = mt.multi_tensor_axpby(xs, ys, 1.0, 1.0, arg_to_check=1, impl=impl)
+        assert bool(flag_both) and not bool(flag_x) and bool(flag_y)
+
+
+@pytest.mark.parametrize("impl", ["jnp", "pallas"])
+class TestL2Norm:
+    def test_global(self, impl):
+        ts = _rand_lists(SHAPES)
+        norm, _ = mt.multi_tensor_l2norm(ts, impl=impl)
+        ref = np.sqrt(sum(float(np.sum(np.asarray(t) ** 2)) for t in ts))
+        np.testing.assert_allclose(float(norm), ref, rtol=1e-5)
+
+    def test_per_tensor(self, impl):
+        ts = _rand_lists(SHAPES)
+        _, per = mt.multi_tensor_l2norm(ts, per_tensor=True, impl=impl)
+        refs = [float(np.linalg.norm(np.asarray(t))) for t in ts]
+        np.testing.assert_allclose(np.asarray(per), refs, rtol=1e-5)
+
+
+class TestOptimizerKernels:
+    """Pallas-vs-jnp trajectory parity over random steps (the role of
+    tests/L0/run_optimizers/test_fused_optimizer.py's torch-reference compare)."""
+
+    def _run_steps(self, fn, n_states, steps=5, **kw):
+        rng = np.random.RandomState(1)
+        params = [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in SHAPES]
+        states = {
+            impl: [params]
+            + [[jnp.zeros_like(p) for p in params] for _ in range(n_states)]
+            for impl in ("jnp", "pallas")
+        }
+        for step in range(1, steps + 1):
+            grads = [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in SHAPES]
+            for impl in ("jnp", "pallas"):
+                states[impl] = list(fn(grads, *states[impl], step=step, impl=impl, **kw))
+        for a, b in zip(states["jnp"], states["pallas"]):
+            for x, y in zip(a, b):
+                np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=2e-5, atol=1e-6)
+        return states["jnp"]
+
+    def test_adam_parity(self):
+        def run(grads, p, m, v, *, step, impl):
+            return mt.multi_tensor_adam(
+                grads, p, m, v, lr=1e-2, step=step, weight_decay=0.01, impl=impl
+            )
+
+        self._run_steps(run, 2)
+
+    def test_adam_matches_optax_adamw(self):
+        import optax
+
+        rng = np.random.RandomState(2)
+        params = [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in SHAPES]
+        m = [jnp.zeros_like(p) for p in params]
+        v = [jnp.zeros_like(p) for p in params]
+        opt = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+        ostate = opt.init(params)
+        oparams = params
+        for step in range(1, 6):
+            grads = [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in SHAPES]
+            params, m, v = mt.multi_tensor_adam(
+                grads, params, m, v, lr=1e-2, step=step, weight_decay=0.01, impl="jnp"
+            )
+            updates, ostate = opt.update(grads, ostate, oparams)
+            oparams = optax.apply_updates(oparams, updates)
+        for a, b in zip(params, oparams):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+    def test_adam_l2_mode(self):
+        def run(grads, p, m, v, *, step, impl):
+            return mt.multi_tensor_adam(
+                grads, p, m, v, lr=1e-2, step=step, weight_decay=0.1,
+                adam_w_mode=False, impl=impl,
+            )
+
+        self._run_steps(run, 2)
+
+    def test_adam_skip_on_found_inf(self):
+        rng = np.random.RandomState(3)
+        params = [jnp.asarray(rng.randn(8, 8).astype(np.float32))]
+        m = [jnp.zeros_like(params[0])]
+        v = [jnp.zeros_like(params[0])]
+        grads = [jnp.ones_like(params[0])]
+        for impl in ("jnp", "pallas"):
+            p2, m2, v2 = mt.multi_tensor_adam(
+                grads, params, m, v, lr=1.0, step=1, found_inf=jnp.float32(1.0), impl=impl
+            )
+            np.testing.assert_array_equal(np.asarray(p2[0]), np.asarray(params[0]))
+            np.testing.assert_array_equal(np.asarray(m2[0]), 0.0)
+
+    def test_sgd_parity(self):
+        def run(grads, p, mom, *, step, impl):
+            return mt.multi_tensor_sgd(
+                grads, p, mom, lr=0.1, weight_decay=1e-4, momentum=0.9,
+                dampening=0.0, nesterov=True, first_run=(step == 1), impl=impl,
+            )
+
+        self._run_steps(run, 1)
+
+    def test_sgd_matches_torch(self):
+        import torch
+
+        rng = np.random.RandomState(4)
+        p0 = rng.randn(31, 7).astype(np.float32)
+        tp = torch.nn.Parameter(torch.tensor(p0))
+        topt = torch.optim.SGD([tp], lr=0.1, momentum=0.9, weight_decay=1e-4)
+        params, mom = [jnp.asarray(p0)], [jnp.zeros((31, 7), jnp.float32)]
+        for step in range(1, 6):
+            g = rng.randn(31, 7).astype(np.float32)
+            topt.zero_grad()
+            tp.grad = torch.tensor(g)
+            topt.step()
+            params, mom = mt.multi_tensor_sgd(
+                [jnp.asarray(g)], params, mom, lr=0.1, weight_decay=1e-4,
+                momentum=0.9, first_run=(step == 1), impl="jnp",
+            )
+        np.testing.assert_allclose(
+            np.asarray(params[0]), tp.detach().numpy(), rtol=1e-5, atol=1e-6
+        )
+
+    def test_adagrad_parity(self):
+        def run(grads, p, h, *, step, impl):
+            return mt.multi_tensor_adagrad(
+                grads, p, h, lr=1e-2, eps=1e-10, weight_decay=1e-3, impl=impl
+            )
+
+        self._run_steps(run, 1)
+
+    def test_lamb_parity(self):
+        def run(grads, p, m, v, *, step, impl):
+            return mt.multi_tensor_lamb(
+                grads, p, m, v, lr=1e-2, step=step, weight_decay=0.01,
+                max_grad_norm=1.0, impl=impl,
+            )
+
+        self._run_steps(run, 2)
+
+    def test_novograd_parity(self):
+        rng = np.random.RandomState(5)
+        params = [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in SHAPES]
+        states = {
+            impl: (params, [jnp.zeros_like(p) for p in params],
+                   jnp.zeros((len(SHAPES),), jnp.float32))
+            for impl in ("jnp", "pallas")
+        }
+        for step in range(1, 5):
+            grads = [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in SHAPES]
+            for impl in ("jnp", "pallas"):
+                p, m, gn = states[impl]
+                states[impl] = mt.multi_tensor_novograd(
+                    grads, p, m, gn, lr=1e-2, step=step, weight_decay=1e-3, impl=impl
+                )
+        for x, y in zip(states["jnp"][0], states["pallas"][0]):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=2e-5, atol=1e-6)
+
+    def test_lars_parity(self):
+        def run(grads, p, mom, *, step, impl):
+            return mt.multi_tensor_lars(
+                grads, p, mom, lr=0.1, weight_decay=1e-4, momentum=0.9,
+                first_run=(step == 1), impl=impl,
+            )
+
+        self._run_steps(run, 1)
+
+
+class TestJit:
+    def test_adam_jits(self):
+        params = [jnp.ones((16, 16)), jnp.ones((5,))]
+        m = [jnp.zeros_like(p) for p in params]
+        v = [jnp.zeros_like(p) for p in params]
+
+        @jax.jit
+        def step(grads, params, m, v):
+            return mt.multi_tensor_adam(grads, params, m, v, lr=1e-3, step=1)
+
+        p2, _, _ = step([jnp.ones((16, 16)), jnp.ones((5,))], params, m, v)
+        assert p2[0].shape == (16, 16)
