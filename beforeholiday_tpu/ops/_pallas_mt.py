@@ -186,6 +186,7 @@ def l2norm_sq(x_flat, *, interpret=None):
     if interpret is None:
         interpret = _interpret_default()
     n = x_flat.shape[0]
+    assert n % BLOCK_ELEMS == 0, f"arena length {n} not padded to {BLOCK_ELEMS}"
     rows = n // LANES
     grid = rows // BLOCK_ROWS
     smem_spec = lambda: pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
@@ -377,6 +378,7 @@ def _lamb1_kernel(mode, scal_ref, fi_ref, g_ref, p_ref, m_ref, v_ref, uo_ref, mo
     beta1, beta2, beta3 = scal_ref[0, 0], scal_ref[0, 1], scal_ref[0, 2]
     bc1, bc2 = scal_ref[0, 3], scal_ref[0, 4]
     eps, decay, clip = scal_ref[0, 5], scal_ref[0, 6], scal_ref[0, 7]
+    skip = fi_ref[0, 0] != 0.0
     g, p, m, v = _f32(g_ref), _f32(p_ref), _f32(m_ref), _f32(v_ref)
 
     sg = g / clip
@@ -387,9 +389,11 @@ def _lamb1_kernel(mode, scal_ref, fi_ref, g_ref, p_ref, m_ref, v_ref, uo_ref, mo
     update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
     if mode == 1:  # decoupled decay
         update = update + decay * p
-    uo_ref[...] = update.astype(uo_ref.dtype)
-    mo_ref[...] = m_new.astype(mo_ref.dtype)
-    vo_ref[...] = v_new.astype(vo_ref.dtype)
+    # skip-step must also hold the moments, or a single overflow step poisons
+    # them forever (same noop semantics as the adam/sgd functors)
+    uo_ref[...] = jnp.where(skip, 0.0, update).astype(uo_ref.dtype)
+    mo_ref[...] = jnp.where(skip, m, m_new).astype(mo_ref.dtype)
+    vo_ref[...] = jnp.where(skip, v, v_new).astype(vo_ref.dtype)
 
 
 def lamb_stage1(
@@ -407,6 +411,7 @@ def lamb_stage1(
     weight_decay,
     clipped_global_grad_norm,
     mode=1,
+    found_inf=None,
     interpret=None,
 ):
     outs, _ = ew_call(
@@ -415,6 +420,8 @@ def lamb_stage1(
         [beta1, beta2, beta3, bias_correction1, bias_correction2, eps, weight_decay,
          clipped_global_grad_norm],
         [jnp.float32, m_flat.dtype, v_flat.dtype],
+        found_inf=found_inf,
+        interpret=interpret,
     )
     return tuple(outs)
 

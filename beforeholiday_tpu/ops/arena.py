@@ -17,6 +17,7 @@ itself over the ``data`` axis (ref: apex/contrib/optimizers/distributed_fused_ad
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, List, Sequence, Tuple
 
 import jax
@@ -52,13 +53,20 @@ class ArenaSpec:
         Padding elements map to ``num_tensors`` (an extra, discarded segment) so
         per-tensor reductions (LAMB/LARS/NovoGrad trust ratios, per-tensor
         l2norm — ref: csrc/multi_tensor_l2norm_kernel.cu per-tensor outputs) are
-        one ``segment_sum`` over the arena.
+        one ``segment_sum`` over the arena. Cached per spec — LAMB queries it
+        three times per eager step and the table is O(arena).
         """
-        ids = np.full((self.padded_total,), self.num_tensors, dtype=np.int32)
-        for i, (off, shape) in enumerate(zip(self.offsets, self.shapes)):
-            n = int(np.prod(shape)) if shape else 1
-            ids[off : off + n] = i
-        return ids
+        return _segment_ids_cached(self)
+
+
+@functools.lru_cache(maxsize=128)
+def _segment_ids_cached(spec: "ArenaSpec") -> np.ndarray:
+    ids = np.full((spec.padded_total,), spec.num_tensors, dtype=np.int32)
+    for i, (off, shape) in enumerate(zip(spec.offsets, spec.shapes)):
+        n = int(np.prod(shape)) if shape else 1
+        ids[off : off + n] = i
+    ids.setflags(write=False)  # shared across callers
+    return ids
 
 
 def make_spec(tensors: Sequence[jax.Array]) -> ArenaSpec:

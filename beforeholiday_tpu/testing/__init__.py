@@ -1,0 +1,10 @@
+"""In-repo reference models and test harnesses.
+
+The reference ships complete GPT/BERT model definitions inside the library for
+its distributed tests (ref: apex/transformer/testing/standalone_gpt.py:111,
+standalone_bert.py:255, standalone_transformer_lm.py:1574). This package plays
+the same role: self-contained models used by the test suite, the benchmark
+driver, and ``__graft_entry__``.
+"""
+
+from beforeholiday_tpu.testing import gpt  # noqa: F401

@@ -41,6 +41,9 @@ def get_logger(name: str = "beforeholiday_tpu") -> logging.Logger:
             )
         )
         logger.addHandler(handler)
-        logger.setLevel(os.environ.get(_LOG_ENV, "WARNING").upper())
+        level = os.environ.get(_LOG_ENV, "WARNING").upper()
+        if not isinstance(logging.getLevelName(level), int):  # unknown name → str
+            level = "WARNING"  # unrecognized env value must not break import
+        logger.setLevel(level)
         logger.propagate = False
     return logger

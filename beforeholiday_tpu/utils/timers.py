@@ -63,9 +63,11 @@ class Timers:
         return self._timers[name]
 
     def log(self, names, normalizer: float = 1.0, reset: bool = True) -> str:
+        for name in names:
+            # a typo'd timer name must be loud, not silently dropped
+            assert name in self._timers, f"timer {name!r} was never started"
         parts = [
             f"{name}: {self._timers[name].elapsed(reset=reset) * 1000.0 / normalizer:.2f}ms"
             for name in names
-            if name in self._timers
         ]
         return "time (ms) | " + " | ".join(parts)

@@ -1,0 +1,91 @@
+"""Benchmark driver — prints ONE JSON line with the headline metric.
+
+Headline metric (BASELINE.json secondary, the first one measurable): fused
+multi-tensor Adam step time over a realistic parameter set, vs. the unfused
+optax.adamw baseline on the same hardware. vs_baseline > 1.0 means the fused
+arena kernel beats per-tensor optax.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _param_set(key, dtype=jnp.float32):
+    """~46M elements across transformer-shaped tensors (BERT-Large-ish slice)."""
+    shapes = (
+        [(1024, 1024)] * 12
+        + [(4096, 1024)] * 3
+        + [(1024, 4096)] * 3
+        + [(30522, 256)]
+        + [(1024,)] * 48
+    )
+    keys = jax.random.split(key, len(shapes))
+    return [jax.random.normal(k, s, dtype) * 0.02 for k, s in zip(keys, shapes)]
+
+
+def _time_it(fn, args, iters=20):
+    out = fn(*args)  # compile
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    from beforeholiday_tpu.ops import multi_tensor_adam
+
+    key = jax.random.PRNGKey(0)
+    params = _param_set(key)
+    grads = _param_set(jax.random.PRNGKey(1))
+    m = [jnp.zeros_like(p) for p in params]
+    v = [jnp.zeros_like(p) for p in params]
+
+    hp = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, step=1,
+              adam_w_mode=True, weight_decay=0.01)
+
+    @jax.jit
+    def fused_step(grads, params, m, v):
+        return multi_tensor_adam(grads, params, m, v, **hp)
+
+    fused_s = _time_it(fused_step, (grads, params, m, v))
+
+    # baseline: optax adamw (per-tensor unfused update)
+    import optax
+
+    opt = optax.adamw(learning_rate=hp["lr"], b1=hp["beta1"], b2=hp["beta2"],
+                      eps=hp["eps"], weight_decay=hp["weight_decay"])
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def optax_step(grads, params, opt_state):
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    optax_s = _time_it(optax_step, (grads, params, opt_state))
+
+    n_elems = int(sum(int(np.prod(p.shape)) for p in params))
+    print(json.dumps({
+        "metric": "fused_adam_step_46M",
+        "value": round(fused_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(optax_s / fused_s, 3),
+        "detail": {
+            "backend": jax.default_backend(),
+            "n_params": n_elems,
+            "optax_adamw_ms": round(optax_s * 1e3, 3),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
