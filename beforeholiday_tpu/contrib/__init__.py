@@ -1,0 +1,3 @@
+"""Optional accelerated modules (ref: apex/contrib/)."""
+
+from beforeholiday_tpu.contrib.clip_grad import clip_grad_norm_  # noqa: F401

@@ -1,0 +1,60 @@
+"""Fused gradient clipping (ref: apex/contrib/clip_grad/clip_grad.py:16).
+
+The reference is a drop-in for ``torch.nn.utils.clip_grad_norm_`` built on
+``amp_C.multi_tensor_l2norm`` + ``multi_tensor_scale``. Functional equivalent:
+returns the clipped gradients and the total norm instead of mutating.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.ops import multi_tensor as mt
+
+
+def clip_grad_norm_(
+    grads: Any,
+    max_norm: float,
+    norm_type: float = 2.0,
+    *,
+    error_if_nonfinite: bool = False,
+    impl=None,
+) -> Tuple[Any, jax.Array]:
+    """Clip a pytree of gradients by global norm. Returns (clipped, total_norm).
+
+    norm_type=2.0 takes the fused multi-tensor path (one arena kernel), exactly
+    as the reference fast-paths L2 (clip_grad.py:49-57); other norms fall back
+    to elementwise jnp like the reference falls back to torch.norm.
+
+    ``error_if_nonfinite`` cannot raise under jit; a non-finite total norm
+    propagates NaN into the clipped grads, matching torch's observable behavior
+    when the flag is False.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if norm_type == 2.0:
+        # bucket by dtype for the arena; combine bucket sumsqs
+        by_dtype = {}
+        for i, g in enumerate(leaves):
+            by_dtype.setdefault(g.dtype, []).append(i)
+        sumsq = jnp.float32(0.0)
+        for dt, idx in by_dtype.items():
+            norm, _ = mt.multi_tensor_l2norm([leaves[i] for i in idx], impl=impl)
+            sumsq = sumsq + norm * norm
+        total_norm = jnp.sqrt(sumsq)
+    elif norm_type == float("inf"):
+        total_norm = jnp.max(
+            jnp.stack([jnp.max(jnp.abs(g.astype(jnp.float32))) for g in leaves])
+        )
+    else:
+        total_norm = (
+            sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type) for g in leaves)
+            ** (1.0 / norm_type)
+        )
+
+    # torch semantics: coef = max_norm / (norm + 1e-6), clamped to <= 1
+    coef = jnp.minimum(max_norm / (total_norm + 1e-6), 1.0)
+    clipped = [(g.astype(jnp.float32) * coef).astype(g.dtype) for g in leaves]
+    return jax.tree_util.tree_unflatten(treedef, clipped), total_norm

@@ -304,7 +304,7 @@ def adagrad(g_flat, p_flat, h_flat, *, lr, eps, weight_decay, mode=0, found_inf=
 def _sgd_kernel(
     flags, scal_ref, fi_ref, g_ref, p_ref, mom_ref, po_ref, momo_ref, copy_ref=None
 ):
-    nesterov, first_run, wd_after_momentum, has_momentum = flags
+    nesterov, wd_after_momentum, has_momentum = flags
     wd, momentum, damp, lr, gscale = (
         scal_ref[0, 0],
         scal_ref[0, 1],
@@ -312,6 +312,10 @@ def _sgd_kernel(
         scal_ref[0, 3],
         scal_ref[0, 4],
     )
+    # first_run is a runtime scalar (traced step==0 in the optimizer classes):
+    # torch SGD seeds the momentum buffer with g, skipping dampening, on the
+    # first step only (ref: multi_tensor_sgd_kernel.cu first_run branch)
+    first_run = scal_ref[0, 5] != 0.0
     skip = fi_ref[0, 0] != 0.0
     g = _f32(g_ref) * gscale
     p, mom = _f32(p_ref), _f32(mom_ref)
@@ -319,7 +323,7 @@ def _sgd_kernel(
     if not wd_after_momentum:
         g = g + wd * p
     if has_momentum:
-        mom_new = g if first_run else mom * momentum + (1.0 - damp) * g
+        mom_new = jnp.where(first_run, g, mom * momentum + (1.0 - damp) * g)
         step = g + momentum * mom_new if nesterov else mom_new
     else:
         mom_new = mom
@@ -353,14 +357,15 @@ def sgd(
     found_inf=None,
     interpret=None,
 ):
-    flags = (bool(nesterov), bool(first_run), bool(wd_after_momentum), momentum != 0.0)
+    flags = (bool(nesterov), bool(wd_after_momentum), momentum != 0.0)
     out_dtypes = [p_flat.dtype, mom_flat.dtype]
     if model_copy_dtype is not None:
         out_dtypes.append(model_copy_dtype)
     outs, _ = ew_call(
         functools.partial(_sgd_kernel, flags),
         [g_flat, p_flat, mom_flat],
-        [weight_decay, momentum, dampening, lr, scale],
+        [weight_decay, momentum, dampening, lr, scale,
+         jnp.asarray(first_run, jnp.float32)],
         out_dtypes,
         found_inf=found_inf,
         interpret=interpret,

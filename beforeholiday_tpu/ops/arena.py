@@ -59,7 +59,7 @@ class ArenaSpec:
         return _segment_ids_cached(self)
 
 
-@functools.lru_cache(maxsize=128)
+@functools.lru_cache(maxsize=8)  # entries are O(arena) bytes — keep the cache tiny
 def _segment_ids_cached(spec: "ArenaSpec") -> np.ndarray:
     ids = np.full((spec.padded_total,), spec.num_tensors, dtype=np.int32)
     for i, (off, shape) in enumerate(zip(spec.offsets, spec.shapes)):

@@ -292,7 +292,8 @@ def multi_tensor_sgd(
         if not wd_after_momentum:
             g = g + weight_decay * p
         if momentum != 0.0:
-            mom_new = g if first_run else mom * momentum + (1.0 - dampening) * g
+            first = jnp.asarray(first_run, jnp.bool_)
+            mom_new = jnp.where(first, g, mom * momentum + (1.0 - dampening) * g)
             step = g + momentum * mom_new if nesterov else mom_new
         else:
             mom_new, step = mom, g
@@ -483,13 +484,16 @@ def multi_tensor_lars(
     # g' = trust * (scale*g + wd*p), then momentum runs on g'
     # (ref: csrc/multi_tensor_lars.cu:129-130 adds wd*p before multiplying by
     # scaled_lr; same math as apex/parallel/LARC.py:79-94). Fold everything into
-    # the gradient here and run fused SGD with wd=0, scale=1.
+    # the gradient here and run fused SGD with wd=0, scale=1. With decay folded
+    # pre-momentum, ``wd_after_momentum`` has nothing left to act on — the
+    # reference kernel likewise accepts but ignores it — so it is not forwarded.
+    del wd_after_momentum
     coef = _segment_coef(trust, spec)
     g_eff = coef * (gf.astype(jnp.float32) * scale + weight_decay * pf.astype(jnp.float32))
     scaled_g = unflatten(g_eff.astype(gf.dtype), spec)
     return multi_tensor_sgd(
         scaled_g, params, momentums, lr=lr, weight_decay=0.0,
         momentum=momentum, dampening=dampening, nesterov=nesterov,
-        first_run=first_run, wd_after_momentum=wd_after_momentum, scale=1.0,
+        first_run=first_run, wd_after_momentum=False, scale=1.0,
         found_inf=found_inf, impl=impl,
     )

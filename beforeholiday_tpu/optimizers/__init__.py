@@ -1,0 +1,21 @@
+"""Fused optimizers (ref: apex/optimizers/ + apex/contrib/optimizers/)."""
+
+from beforeholiday_tpu.optimizers.fused import (  # noqa: F401
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedLARS,
+    FusedMixedPrecisionLamb,
+    FusedNovoGrad,
+    FusedSGD,
+)
+
+__all__ = [
+    "FusedAdagrad",
+    "FusedAdam",
+    "FusedLAMB",
+    "FusedLARS",
+    "FusedMixedPrecisionLamb",
+    "FusedNovoGrad",
+    "FusedSGD",
+]

@@ -1,0 +1,538 @@
+"""Fused optimizers — TPU port of ``apex.optimizers``.
+
+Each optimizer follows the reference's structure (bucket params, then one fused
+multi-tensor call per bucket — ref: apex/optimizers/fused_adam.py:117-190) with a
+functional state API instead of in-place mutation:
+
+    opt = FusedAdam(lr=1e-3)
+    state = opt.init(params)                       # pytree of fp32 moments + step
+    params, state = opt.step(params, grads, state) # pure, jittable
+
+Buckets are keyed by (param dtype, grad dtype, weight-decay on/off): the
+reference buckets fp16/bf16 vs fp32 (fused_adam.py:149-180), and per-group
+weight decay (torch param_groups) maps to the ``no_weight_decay_mask``
+constructor arg — a pytree/callable marking leaves excluded from decay, the
+standard exclude-norms-and-biases policy.
+
+``found_inf`` (a traced 0/1 scalar from the amp LossScaler) makes the entire
+step an identity and holds the step counter — the device-side skip-step
+(ref: apex/amp/handle.py:127-154) with no host sync.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.ops import multi_tensor as mt
+
+Mask = Union[None, Any, Callable[[Tuple[Any, ...]], bool]]
+
+
+def _leaf_flags(mask: Mask, params) -> List[bool]:
+    """Resolve a no-weight-decay mask to one bool per leaf (True = NO decay)."""
+    if mask is None:
+        return [False] * len(jax.tree_util.tree_leaves(params))
+    if callable(mask):
+        paths = jax.tree_util.tree_flatten_with_path(params)[0]
+        return [bool(mask(path)) for path, _ in paths]
+    return [bool(x) for x in jax.tree_util.tree_leaves(mask)]
+
+
+def _buckets(pleaves, gleaves, nowd_flags) -> Dict[tuple, List[int]]:
+    out: Dict[tuple, List[int]] = {}
+    for i, (p, g, nowd) in enumerate(zip(pleaves, gleaves, nowd_flags)):
+        out.setdefault((p.dtype, g.dtype, nowd), []).append(i)
+    return out
+
+
+def _gather(leaves, idx):
+    return [leaves[i] for i in idx]
+
+
+def _scatter(dst: list, idx, values):
+    for i, v in zip(idx, values):
+        dst[i] = v
+
+
+class _FusedOptimizer:
+    """Shared bucketing/step-count machinery."""
+
+    def __init__(self, *, state_dtype=jnp.float32, no_weight_decay_mask: Mask = None):
+        self.state_dtype = state_dtype
+        self.no_weight_decay_mask = no_weight_decay_mask
+
+    # subclasses: dict of per-leaf state arrays
+    def _init_leaf_state(self, leaf) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    def _state_keys(self) -> Sequence[str]:
+        raise NotImplementedError
+
+    def init(self, params) -> Dict[str, Any]:
+        state = {
+            key: jax.tree.map(lambda p: self._init_leaf_state(p)[key], params)
+            for key in self._state_keys()
+        }
+        state["step"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def _next_step(self, state, found_inf):
+        """Step counter: increments only on unskipped steps (the reference skips
+        optimizer.step() entirely on overflow, so the count never advances)."""
+        step = state["step"]
+        if found_inf is None:
+            return step + 1
+        return jnp.where(jnp.asarray(found_inf) != 0, step, step + 1)
+
+    def as_optax(self):
+        """Adapter to an ``optax.GradientTransformation`` (fp32 use)."""
+        import optax
+
+        def init_fn(params):
+            return (self.init(params), params)
+
+        def update_fn(grads, state, params=None):
+            inner, _ = state
+            assert params is not None, "fused optimizers need params in update()"
+            new_params, new_inner = self.step(params, grads, inner)
+            updates = jax.tree.map(lambda n, p: n - p, new_params, params)
+            return updates, (new_inner, new_params)
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedAdam(_FusedOptimizer):
+    """Fused Adam/AdamW (ref: apex/optimizers/fused_adam.py:4, csrc/multi_tensor_adam.cu:24)."""
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        *,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        bias_correction: bool = True,
+        state_dtype=jnp.float32,
+        no_weight_decay_mask: Mask = None,
+        impl: Optional[str] = None,
+    ):
+        super().__init__(state_dtype=state_dtype, no_weight_decay_mask=no_weight_decay_mask)
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.impl = impl
+
+    def _state_keys(self):
+        return ("exp_avg", "exp_avg_sq")
+
+    def _init_leaf_state(self, leaf):
+        z = jnp.zeros(leaf.shape, self.state_dtype)
+        return {"exp_avg": z, "exp_avg_sq": z}
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        lr = self.lr if lr is None else lr
+        pleaves, treedef = jax.tree_util.tree_flatten(params)
+        gleaves = jax.tree_util.tree_leaves(grads)
+        mleaves = jax.tree_util.tree_leaves(state["exp_avg"])
+        vleaves = jax.tree_util.tree_leaves(state["exp_avg_sq"])
+        nowd = _leaf_flags(self.no_weight_decay_mask, params)
+        step_no = self._next_step(state, found_inf)
+
+        new_p, new_m, new_v = list(pleaves), list(mleaves), list(vleaves)
+        for (pd, gd, no_decay), idx in _buckets(pleaves, gleaves, nowd).items():
+            p2, m2, v2 = mt.multi_tensor_adam(
+                _gather(gleaves, idx), _gather(pleaves, idx),
+                _gather(mleaves, idx), _gather(vleaves, idx),
+                lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+                step=step_no, adam_w_mode=self.adam_w_mode,
+                bias_correction=self.bias_correction,
+                weight_decay=0.0 if no_decay else self.weight_decay,
+                grad_scale=grad_scale, found_inf=found_inf, impl=self.impl,
+            )
+            _scatter(new_p, idx, p2)
+            _scatter(new_m, idx, m2)
+            _scatter(new_v, idx, v2)
+
+        unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return unflat(new_p), {
+            "exp_avg": unflat(new_m),
+            "exp_avg_sq": unflat(new_v),
+            "step": step_no,
+        }
+
+
+class FusedSGD(_FusedOptimizer):
+    """Fused SGD with momentum/nesterov (ref: apex/optimizers/fused_sgd.py:6)."""
+
+    def __init__(
+        self,
+        lr: float,
+        momentum: float = 0.0,
+        dampening: float = 0.0,
+        *,
+        weight_decay: float = 0.0,
+        nesterov: bool = False,
+        wd_after_momentum: bool = False,
+        state_dtype=jnp.float32,
+        no_weight_decay_mask: Mask = None,
+        impl: Optional[str] = None,
+    ):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+        super().__init__(state_dtype=state_dtype, no_weight_decay_mask=no_weight_decay_mask)
+        self.lr, self.momentum, self.dampening = lr, momentum, dampening
+        self.weight_decay, self.nesterov = weight_decay, nesterov
+        self.wd_after_momentum = wd_after_momentum
+        self.impl = impl
+
+    def _state_keys(self):
+        return ("momentum_buffer",)
+
+    def _init_leaf_state(self, leaf):
+        return {"momentum_buffer": jnp.zeros(leaf.shape, self.state_dtype)}
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        lr = self.lr if lr is None else lr
+        pleaves, treedef = jax.tree_util.tree_flatten(params)
+        gleaves = jax.tree_util.tree_leaves(grads)
+        bleaves = jax.tree_util.tree_leaves(state["momentum_buffer"])
+        nowd = _leaf_flags(self.no_weight_decay_mask, params)
+        first_run = state["step"] == 0
+        step_no = self._next_step(state, found_inf)
+
+        new_p, new_b = list(pleaves), list(bleaves)
+        for (pd, gd, no_decay), idx in _buckets(pleaves, gleaves, nowd).items():
+            p2, b2 = mt.multi_tensor_sgd(
+                _gather(gleaves, idx), _gather(pleaves, idx), _gather(bleaves, idx),
+                lr=lr, weight_decay=0.0 if no_decay else self.weight_decay,
+                momentum=self.momentum, dampening=self.dampening,
+                nesterov=self.nesterov, first_run=first_run,
+                wd_after_momentum=self.wd_after_momentum, scale=grad_scale,
+                found_inf=found_inf, impl=self.impl,
+            )
+            _scatter(new_p, idx, p2)
+            _scatter(new_b, idx, b2)
+
+        unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return unflat(new_p), {"momentum_buffer": unflat(new_b), "step": step_no}
+
+
+class FusedAdagrad(_FusedOptimizer):
+    """Fused Adagrad (ref: apex/optimizers/fused_adagrad.py:5)."""
+
+    def __init__(
+        self,
+        lr: float = 1e-2,
+        eps: float = 1e-10,
+        *,
+        weight_decay: float = 0.0,
+        adagrad_w_mode: bool = False,
+        state_dtype=jnp.float32,
+        no_weight_decay_mask: Mask = None,
+        impl: Optional[str] = None,
+    ):
+        super().__init__(state_dtype=state_dtype, no_weight_decay_mask=no_weight_decay_mask)
+        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
+        self.adagrad_w_mode = adagrad_w_mode
+        self.impl = impl
+
+    def _state_keys(self):
+        return ("sum",)
+
+    def _init_leaf_state(self, leaf):
+        return {"sum": jnp.zeros(leaf.shape, self.state_dtype)}
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        lr = self.lr if lr is None else lr
+        pleaves, treedef = jax.tree_util.tree_flatten(params)
+        gleaves = jax.tree_util.tree_leaves(grads)
+        hleaves = jax.tree_util.tree_leaves(state["sum"])
+        nowd = _leaf_flags(self.no_weight_decay_mask, params)
+        step_no = self._next_step(state, found_inf)
+
+        # grad_scale may be a traced scalar (amp inverse loss scale) — never
+        # branch on it; fold it in unconditionally
+        gleaves = [g.astype(jnp.float32) * grad_scale for g in gleaves]
+        new_p, new_h = list(pleaves), list(hleaves)
+        for (pd, gd, no_decay), idx in _buckets(pleaves, gleaves, nowd).items():
+            p2, h2 = mt.multi_tensor_adagrad(
+                _gather(gleaves, idx), _gather(pleaves, idx), _gather(hleaves, idx),
+                lr=lr, eps=self.eps,
+                weight_decay=0.0 if no_decay else self.weight_decay,
+                mode=1 if self.adagrad_w_mode else 0,
+                found_inf=found_inf, impl=self.impl,
+            )
+            _scatter(new_p, idx, p2)
+            _scatter(new_h, idx, h2)
+
+        unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return unflat(new_p), {"sum": unflat(new_h), "step": step_no}
+
+
+class FusedLAMB(_FusedOptimizer):
+    """Fused LAMB with in-step global-grad-norm clipping
+    (ref: apex/optimizers/fused_lamb.py:4, step at :124-199)."""
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-6,
+        *,
+        weight_decay: float = 0.01,
+        bias_correction: bool = True,
+        grad_averaging: bool = True,
+        adam_w_mode: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        state_dtype=jnp.float32,
+        no_weight_decay_mask: Mask = None,
+        impl: Optional[str] = None,
+    ):
+        super().__init__(state_dtype=state_dtype, no_weight_decay_mask=no_weight_decay_mask)
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.grad_averaging = grad_averaging
+        self.adam_w_mode = adam_w_mode
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+        self.impl = impl
+
+    def _state_keys(self):
+        return ("exp_avg", "exp_avg_sq")
+
+    def _init_leaf_state(self, leaf):
+        z = jnp.zeros(leaf.shape, self.state_dtype)
+        return {"exp_avg": z, "exp_avg_sq": z}
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        lr = self.lr if lr is None else lr
+        pleaves, treedef = jax.tree_util.tree_flatten(params)
+        gleaves = jax.tree_util.tree_leaves(grads)
+        mleaves = jax.tree_util.tree_leaves(state["exp_avg"])
+        vleaves = jax.tree_util.tree_leaves(state["exp_avg_sq"])
+        nowd = _leaf_flags(self.no_weight_decay_mask, params)
+        step_no = self._next_step(state, found_inf)
+
+        # grad_scale may be a traced scalar (amp inverse loss scale) — never
+        # branch on it; fold it in unconditionally
+        gleaves = [g.astype(jnp.float32) * grad_scale for g in gleaves]
+        # global grad norm across ALL buckets before per-bucket updates
+        # (ref: fused_lamb.py:124-147 multi_tensor_l2norm over both dtype lists)
+        gnorm = jnp.sqrt(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gleaves)
+        )
+
+        new_p, new_m, new_v = list(pleaves), list(mleaves), list(vleaves)
+        for (pd, gd, no_decay), idx in _buckets(pleaves, gleaves, nowd).items():
+            p2, m2, v2 = mt.multi_tensor_lamb(
+                _gather(gleaves, idx), _gather(pleaves, idx),
+                _gather(mleaves, idx), _gather(vleaves, idx),
+                lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+                step=step_no, bias_correction=self.bias_correction,
+                weight_decay=0.0 if no_decay else self.weight_decay,
+                grad_averaging=self.grad_averaging,
+                mode=1 if self.adam_w_mode else 0,
+                global_grad_norm=gnorm, max_grad_norm=self.max_grad_norm,
+                use_nvlamb=self.use_nvlamb, found_inf=found_inf, impl=self.impl,
+            )
+            _scatter(new_p, idx, p2)
+            _scatter(new_m, idx, m2)
+            _scatter(new_v, idx, v2)
+
+        unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return unflat(new_p), {
+            "exp_avg": unflat(new_m),
+            "exp_avg_sq": unflat(new_v),
+            "step": step_no,
+        }
+
+
+class FusedNovoGrad(_FusedOptimizer):
+    """Fused NovoGrad — per-tensor second moments (ref: apex/optimizers/fused_novograd.py:4)."""
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        betas: Tuple[float, float] = (0.95, 0.98),
+        eps: float = 1e-8,
+        *,
+        weight_decay: float = 0.0,
+        bias_correction: bool = True,
+        grad_averaging: bool = True,
+        moment_mode: int = 0,
+        state_dtype=jnp.float32,
+        no_weight_decay_mask: Mask = None,
+        impl: Optional[str] = None,
+    ):
+        super().__init__(state_dtype=state_dtype, no_weight_decay_mask=no_weight_decay_mask)
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.grad_averaging = grad_averaging
+        self.moment_mode = moment_mode
+        self.impl = impl
+
+    def _state_keys(self):
+        return ("exp_avg", "v_per_tensor")
+
+    def _init_leaf_state(self, leaf):
+        return {
+            "exp_avg": jnp.zeros(leaf.shape, self.state_dtype),
+            # one scalar second moment per tensor (ref: fused_novograd.py v buffers)
+            "v_per_tensor": jnp.zeros((), jnp.float32),
+        }
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        lr = self.lr if lr is None else lr
+        pleaves, treedef = jax.tree_util.tree_flatten(params)
+        gleaves = jax.tree_util.tree_leaves(grads)
+        mleaves = jax.tree_util.tree_leaves(state["exp_avg"])
+        vleaves = jax.tree_util.tree_leaves(state["v_per_tensor"])
+        nowd = _leaf_flags(self.no_weight_decay_mask, params)
+        step_no = self._next_step(state, found_inf)
+
+        # grad_scale may be a traced scalar (amp inverse loss scale) — never
+        # branch on it; fold it in unconditionally
+        gleaves = [g.astype(jnp.float32) * grad_scale for g in gleaves]
+        new_p, new_m, new_v = list(pleaves), list(mleaves), list(vleaves)
+        for (pd, gd, no_decay), idx in _buckets(pleaves, gleaves, nowd).items():
+            p2, m2, v2 = mt.multi_tensor_novograd(
+                _gather(gleaves, idx), _gather(pleaves, idx), _gather(mleaves, idx),
+                jnp.stack(_gather(vleaves, idx)),
+                lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+                step=step_no, bias_correction=self.bias_correction,
+                weight_decay=0.0 if no_decay else self.weight_decay,
+                grad_averaging=self.grad_averaging, moment_mode=self.moment_mode,
+                found_inf=found_inf, impl=self.impl,
+            )
+            _scatter(new_p, idx, p2)
+            _scatter(new_m, idx, m2)
+            _scatter(new_v, idx, [v2[i] for i in range(len(idx))])
+
+        unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return unflat(new_p), {
+            "exp_avg": unflat(new_m),
+            "v_per_tensor": unflat(new_v),
+            "step": step_no,
+        }
+
+
+class FusedLARS(_FusedOptimizer):
+    """Fused LARS — layer-wise adaptive rate SGD (ref: apex/optimizers/fused_lars.py:7)."""
+
+    def __init__(
+        self,
+        lr: float,
+        momentum: float = 0.0,
+        dampening: float = 0.0,
+        *,
+        weight_decay: float = 0.0,
+        nesterov: bool = False,
+        trust_coefficient: float = 0.001,
+        epsilon: float = 0.0,
+        wd_after_momentum: bool = False,
+        state_dtype=jnp.float32,
+        no_weight_decay_mask: Mask = None,
+        impl: Optional[str] = None,
+    ):
+        super().__init__(state_dtype=state_dtype, no_weight_decay_mask=no_weight_decay_mask)
+        self.lr, self.momentum, self.dampening = lr, momentum, dampening
+        self.weight_decay, self.nesterov = weight_decay, nesterov
+        self.trust_coefficient, self.epsilon = trust_coefficient, epsilon
+        self.wd_after_momentum = wd_after_momentum
+        self.impl = impl
+
+    def _state_keys(self):
+        return ("momentum_buffer",)
+
+    def _init_leaf_state(self, leaf):
+        return {"momentum_buffer": jnp.zeros(leaf.shape, self.state_dtype)}
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        lr = self.lr if lr is None else lr
+        pleaves, treedef = jax.tree_util.tree_flatten(params)
+        gleaves = jax.tree_util.tree_leaves(grads)
+        bleaves = jax.tree_util.tree_leaves(state["momentum_buffer"])
+        nowd = _leaf_flags(self.no_weight_decay_mask, params)
+        first_run = state["step"] == 0
+        step_no = self._next_step(state, found_inf)
+
+        new_p, new_b = list(pleaves), list(bleaves)
+        for (pd, gd, no_decay), idx in _buckets(pleaves, gleaves, nowd).items():
+            p2, b2 = mt.multi_tensor_lars(
+                _gather(gleaves, idx), _gather(pleaves, idx), _gather(bleaves, idx),
+                lr=lr, trust_coefficient=self.trust_coefficient,
+                epsilon=self.epsilon,
+                weight_decay=0.0 if no_decay else self.weight_decay,
+                momentum=self.momentum, dampening=self.dampening,
+                nesterov=self.nesterov, first_run=first_run,
+                wd_after_momentum=self.wd_after_momentum, scale=grad_scale,
+                found_inf=found_inf, impl=self.impl,
+            )
+            _scatter(new_p, idx, p2)
+            _scatter(new_b, idx, b2)
+
+        unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return unflat(new_p), {"momentum_buffer": unflat(new_b), "step": step_no}
+
+
+class FusedMixedPrecisionLamb(_FusedOptimizer):
+    """LAMB over fp32 master state with low-precision model params
+    (ref: apex/optimizers/fused_mixed_precision_lamb.py:8).
+
+    ``init`` snapshots fp32 masters from the (bf16/fp16) model params; ``step``
+    updates the masters and re-emits model params in the model dtype. ``step``
+    accepts the amp scaler's ``grad_scale``/``found_inf`` directly, like the
+    reference's ``step(grad_scaler=...)`` (:140).
+    """
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-6,
+        *,
+        weight_decay: float = 0.01,
+        bias_correction: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        no_weight_decay_mask: Mask = None,
+        impl: Optional[str] = None,
+    ):
+        super().__init__(state_dtype=jnp.float32, no_weight_decay_mask=no_weight_decay_mask)
+        self._lamb = FusedLAMB(
+            lr, betas, eps, weight_decay=weight_decay,
+            bias_correction=bias_correction, grad_averaging=grad_averaging,
+            adam_w_mode=True, max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb,
+            no_weight_decay_mask=no_weight_decay_mask, impl=impl,
+        )
+        self.lr = lr
+
+    def init(self, params):
+        master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+        state = self._lamb.init(master)
+        state["master"] = master
+        return state
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        master = state["master"]
+        grads32 = jax.tree.map(
+            lambda g: g.astype(jnp.float32) * grad_scale, grads
+        )
+        inner = {k: state[k] for k in ("exp_avg", "exp_avg_sq", "step")}
+        new_master, new_inner = self._lamb.step(
+            master, grads32, inner, found_inf=found_inf, lr=lr
+        )
+        new_params = jax.tree.map(
+            lambda m, p: m.astype(p.dtype), new_master, params
+        )
+        new_inner["master"] = new_master
+        return new_params, new_inner
