@@ -1,0 +1,16 @@
+"""Mixed-precision policy engine (ref: apex/amp/).
+
+Opt levels O0-O5 as dtype policies, dynamic loss scaling carried in device
+state, and fp32 master weights — `initialize`-compatible surface for
+functional JAX models.
+"""
+
+from beforeholiday_tpu.amp.frontend import (  # noqa: F401
+    AmpModel,
+    MasterWeights,
+    Properties,
+    initialize,
+    opt_levels,
+    scaled_value_and_grad,
+)
+from beforeholiday_tpu.amp.scaler import LossScaler  # noqa: F401

@@ -1,0 +1,271 @@
+"""amp frontend — opt levels O0–O5 and ``initialize`` for functional models.
+
+The reference's ``amp.initialize`` rewires a torch model in place: casts
+weights, patches ``forward`` to cast inputs, builds fp32 masters, patches
+``optimizer.step`` (ref: apex/amp/frontend.py:259-431, _initialize.py:147-267).
+A functional framework cannot (and should not) monkey-patch; the same policy
+becomes explicit dataflow:
+
+* weight casting    → ``initialize`` returns a cast params pytree
+  (norm/batchnorm leaves kept fp32 per ``keep_batchnorm_fp32``, the
+  ``convert_network`` rule);
+* forward patching  → the returned ``apply`` wrapper casts array inputs to the
+  compute dtype and outputs back to fp32 (``cast_model_outputs``);
+* O1's function patching → under jit every cast is traced and fused, so the
+  "patch + cast cache" machinery (apex/amp/amp.py:75-198, utils.py:101-123)
+  reduces to casting at the apply boundary with fp32 storage;
+* optimizer patching → a master-weights wrapper with the scaler's
+  ``found_inf``/``grad_scale`` threaded through (skip-step with no host sync).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.amp.scaler import LossScaler
+from beforeholiday_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Properties:
+    """Opt-level property set (ref: apex/amp/frontend.py:8-52 ``Properties``)."""
+
+    enabled: bool = True
+    opt_level: str = "O0"
+    cast_model_type: Optional[Any] = None  # storage dtype for params
+    patch_torch_functions: bool = False  # compute-dtype casting w/ fp32 storage
+    patch_torch_functions_type: Optional[Any] = None
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: Any = 1.0  # "dynamic" | float
+
+    @property
+    def compute_dtype(self):
+        """dtype arithmetic runs in: patched-functions type, else storage type."""
+        if self.patch_torch_functions and self.patch_torch_functions_type is not None:
+            return self.patch_torch_functions_type
+        return self.cast_model_type or jnp.float32
+
+
+# ref: apex/amp/frontend.py:70-247 O0..O5 classes. O4/O5 (bf16) are the
+# ROCm-fork additions and the natural TPU defaults.
+opt_levels: Dict[str, Properties] = {
+    "O0": Properties(opt_level="O0", cast_model_type=jnp.float32,
+                     master_weights=False, loss_scale=1.0),
+    "O1": Properties(opt_level="O1", patch_torch_functions=True,
+                     patch_torch_functions_type=jnp.float16, loss_scale="dynamic"),
+    "O2": Properties(opt_level="O2", cast_model_type=jnp.float16,
+                     keep_batchnorm_fp32=True, master_weights=True,
+                     loss_scale="dynamic"),
+    "O3": Properties(opt_level="O3", cast_model_type=jnp.float16,
+                     keep_batchnorm_fp32=False, master_weights=False, loss_scale=1.0),
+    "O4": Properties(opt_level="O4", patch_torch_functions=True,
+                     patch_torch_functions_type=jnp.bfloat16, loss_scale=1.0),
+    "O5": Properties(opt_level="O5", cast_model_type=jnp.bfloat16,
+                     keep_batchnorm_fp32=True, master_weights=True, loss_scale=1.0),
+}
+
+
+def _default_keep_fp32(path: Tuple[Any, ...]) -> bool:
+    """Heuristic for ``keep_batchnorm_fp32``: norm-layer parameters stay fp32.
+
+    The reference excludes BatchNorm modules from casting by module class
+    (``convert_network``, apex/fp16_utils/fp16util.py); a params pytree carries
+    names, not classes, so match norm-ish path components.
+    """
+    for part in path:
+        name = getattr(part, "key", None) or getattr(part, "name", None) or str(part)
+        low = str(name).lower()
+        if (
+            "norm" in low  # layernorm, rmsnorm, groupnorm, norm
+            or low.startswith("bn") or low.endswith("bn")  # bn1, sync_bn
+            or low.startswith("ln")  # ln1_scale, lnf_bias
+        ):
+            return True
+    return False
+
+
+def _cast_params(params, policy: Properties, keep_fp32_mask):
+    if policy.cast_model_type is None:
+        return params
+    target = policy.cast_model_type
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    keep = keep_fp32_mask if keep_fp32_mask is not None else _default_keep_fp32
+    out = []
+    for path, leaf in flat:
+        if (
+            policy.keep_batchnorm_fp32
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+            and keep(path)
+        ):
+            out.append(leaf.astype(jnp.float32))
+        elif jnp.issubdtype(leaf.dtype, jnp.floating):
+            out.append(leaf.astype(target))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), out
+    )
+
+
+def _cast_floats(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+class MasterWeights:
+    """fp32 master-weight optimizer wrapper (ref: apex/amp/_process_optimizer.py:321-489).
+
+    ``init`` snapshots fp32 masters from the (possibly low-precision) model
+    params; ``step`` updates the masters with fp32 grads and re-casts into each
+    model leaf's dtype — the reference's lazy master creation +
+    ``_master_params_to_model_params`` copy (:14-25), made explicit.
+    """
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def init(self, params):
+        master = _cast_floats(params, jnp.float32)
+        return {"inner": self.inner.init(master), "master": master}
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
+        master = state["master"]
+        grads32 = _cast_floats(grads, jnp.float32)
+        new_master, new_inner = self.inner.step(
+            master, grads32, state["inner"],
+            found_inf=found_inf, grad_scale=grad_scale, **kw,
+        )
+        new_params = jax.tree.map(
+            lambda m, p: m.astype(p.dtype) if hasattr(p, "dtype") else m,
+            new_master, params,
+        )
+        return new_params, {"inner": new_inner, "master": new_master}
+
+    def master_params(self, state):
+        """Iterator over master leaves (ref: apex/amp/_amp_state.py master_params)."""
+        return jax.tree_util.tree_leaves(state["master"])
+
+
+@dataclasses.dataclass
+class AmpModel:
+    """Bundle returned by ``initialize`` — the functional analogue of the
+    (patched model, patched optimizer) pair."""
+
+    policy: Properties
+    apply: Callable  # wrapped apply: casts inputs/outputs per policy
+    params: Any  # storage-dtype params
+    optimizer: Any  # possibly MasterWeights-wrapped
+    scaler: LossScaler
+
+    def state_dict(self, scaler_state) -> Dict[str, Any]:
+        """Scaler checkpoint (ref: apex/amp/frontend.py:434-452 amp.state_dict)."""
+        return {"loss_scaler0": self.scaler.state_dict(scaler_state)}
+
+    def load_state_dict(self, state_dict) -> Dict[str, jax.Array]:
+        return self.scaler.load_state_dict(state_dict["loss_scaler0"])
+
+
+def initialize(
+    apply_fn: Callable,
+    params: Any,
+    optimizer: Any = None,
+    opt_level: str = "O5",
+    *,
+    cast_model_outputs: Optional[Any] = jnp.float32,
+    keep_batchnorm_fp32: Optional[bool] = None,
+    master_weights: Optional[bool] = None,
+    loss_scale: Optional[Any] = None,
+    keep_fp32_mask: Optional[Callable] = None,
+) -> AmpModel:
+    """Apply an opt-level policy to (apply_fn, params, optimizer).
+
+    Ref: apex/amp/frontend.py:259-431 — including the explicit-override rule:
+    ``keep_batchnorm_fp32``/``master_weights``/``loss_scale`` kwargs override
+    the opt-level defaults (:347-390). The TPU-native default is O5 (bf16 +
+    fp32 masters, no loss scaling).
+
+    ``apply_fn(params, *inputs)`` is the model forward. The returned
+    ``AmpModel.apply`` casts floating inputs (and, per O1/O4 semantics, the
+    fp32-stored params) to the compute dtype and the outputs to
+    ``cast_model_outputs``.
+    """
+    if opt_level not in opt_levels:
+        raise RuntimeError(
+            f"Unexpected optimization level {opt_level}. Options are 'O0', 'O1', "
+            "'O2', 'O3', 'O4', 'O5'."
+        )
+    policy = opt_levels[opt_level]
+    overrides = {}
+    if keep_batchnorm_fp32 is not None:
+        overrides["keep_batchnorm_fp32"] = keep_batchnorm_fp32
+    if master_weights is not None:
+        overrides["master_weights"] = master_weights
+    if loss_scale is not None:
+        overrides["loss_scale"] = loss_scale
+    if overrides:
+        policy = dataclasses.replace(policy, **overrides)
+    logger.info("amp.initialize: %s", policy)
+
+    cast_params = _cast_params(params, policy, keep_fp32_mask)
+    compute_dtype = policy.compute_dtype
+
+    def amp_apply(p, *inputs, **kwinputs):
+        if policy.patch_torch_functions:
+            # O1/O4: fp32 storage, low-precision compute — the cast happens at
+            # the trace boundary and XLA fuses it (the "cast cache" for free)
+            p = _cast_floats(p, compute_dtype)
+        inputs = _cast_floats(inputs, compute_dtype)
+        kwinputs = _cast_floats(kwinputs, compute_dtype)
+        out = apply_fn(p, *inputs, **kwinputs)
+        if cast_model_outputs is not None:
+            out = _cast_floats(out, cast_model_outputs)
+        return out
+
+    opt = optimizer
+    if opt is not None and policy.master_weights:
+        opt = MasterWeights(opt)
+
+    scaler = LossScaler(loss_scale=policy.loss_scale)
+    return AmpModel(
+        policy=policy, apply=amp_apply, params=cast_params,
+        optimizer=opt, scaler=scaler,
+    )
+
+
+def scaled_value_and_grad(
+    loss_fn: Callable, scaler: LossScaler, *, has_aux: bool = False, impl=None
+):
+    """The functional ``amp.scale_loss`` (ref: apex/amp/handle.py:17-158).
+
+    Returns ``f(params, scaler_state, *args) -> (loss, grads, found_inf,
+    new_scaler_state)``: grads of ``scale*loss`` are unscaled to fp32, overflow
+    is detected in the fused unscale kernel, and the scaler state advances —
+    the context manager's enter/exit collapsed into one jittable call. Thread
+    ``found_inf`` into ``optimizer.step`` for the skip-step.
+    """
+
+    def wrapped(params, scaler_state, *args, **kw):
+        def scaled_loss_fn(p):
+            res = loss_fn(p, *args, **kw)
+            loss, aux = res if has_aux else (res, None)
+            return scaler.scale_loss(loss, scaler_state), (loss, aux)
+
+        grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(params)
+        grads, found_inf = scaler.unscale(grads, scaler_state, impl=impl)
+        new_state = scaler.update(scaler_state, found_inf)
+        if has_aux:
+            return loss, aux, grads, found_inf, new_state
+        return loss, grads, found_inf, new_state
+
+    return wrapped

@@ -33,15 +33,27 @@ Mask = Union[None, Any, Callable[[Tuple[Any, ...]], bool]]
 
 def _leaf_flags(mask: Mask, params) -> List[bool]:
     """Resolve a no-weight-decay mask to one bool per leaf (True = NO decay)."""
+    n = len(jax.tree_util.tree_leaves(params))
     if mask is None:
-        return [False] * len(jax.tree_util.tree_leaves(params))
+        return [False] * n
     if callable(mask):
         paths = jax.tree_util.tree_flatten_with_path(params)[0]
         return [bool(mask(path)) for path, _ in paths]
-    return [bool(x) for x in jax.tree_util.tree_leaves(mask)]
+    flags = [bool(x) for x in jax.tree_util.tree_leaves(mask)]
+    if len(flags) != n:
+        raise ValueError(
+            f"no_weight_decay_mask has {len(flags)} leaves but params has {n}; "
+            "the mask must mark every leaf (or be a callable on paths)"
+        )
+    return flags
 
 
 def _buckets(pleaves, gleaves, nowd_flags) -> Dict[tuple, List[int]]:
+    # zip() would silently drop trailing leaves on a malformed grads tree,
+    # freezing those params for the whole run — fail loudly instead
+    assert len(pleaves) == len(gleaves) == len(nowd_flags), (
+        f"params/grads leaf mismatch: {len(pleaves)} vs {len(gleaves)}"
+    )
     out: Dict[tuple, List[int]] = {}
     for i, (p, g, nowd) in enumerate(zip(pleaves, gleaves, nowd_flags)):
         out.setdefault((p.dtype, g.dtype, nowd), []).append(i)
@@ -92,14 +104,13 @@ class _FusedOptimizer:
         import optax
 
         def init_fn(params):
-            return (self.init(params), params)
+            return self.init(params)
 
         def update_fn(grads, state, params=None):
-            inner, _ = state
             assert params is not None, "fused optimizers need params in update()"
-            new_params, new_inner = self.step(params, grads, inner)
+            new_params, new_state = self.step(params, grads, state)
             updates = jax.tree.map(lambda n, p: n - p, new_params, params)
-            return updates, (new_inner, new_params)
+            return updates, new_state
 
         return optax.GradientTransformation(init_fn, update_fn)
 
@@ -325,9 +336,14 @@ class FusedLAMB(_FusedOptimizer):
         gleaves = [g.astype(jnp.float32) * grad_scale for g in gleaves]
         # global grad norm across ALL buckets before per-bucket updates
         # (ref: fused_lamb.py:124-147 multi_tensor_l2norm over both dtype lists)
-        gnorm = jnp.sqrt(
-            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gleaves)
-        )
+        by_dtype: Dict[Any, List[int]] = {}
+        for i, g in enumerate(gleaves):
+            by_dtype.setdefault(g.dtype, []).append(i)
+        sumsq = jnp.float32(0.0)
+        for dt, didx in by_dtype.items():
+            n, _ = mt.multi_tensor_l2norm(_gather(gleaves, didx), impl=self.impl)
+            sumsq = sumsq + n * n
+        gnorm = jnp.sqrt(sumsq)
 
         new_p, new_m, new_v = list(pleaves), list(mleaves), list(vleaves)
         for (pd, gd, no_decay), idx in _buckets(pleaves, gleaves, nowd).items():

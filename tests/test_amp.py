@@ -1,0 +1,293 @@
+"""amp behavioral contracts.
+
+Ports of the reference's L0/run_amp strategy: dtype outcomes per opt level
+(test_basic_casts.py), dynamic scaler dynamics with inf/nan injection
+(test_multi_tensor_scale.py overflow paths, scaler.py window semantics),
+checkpoint round-trip (test_checkpointing.py), and end-to-end skip-step
+training (apex/amp/handle.py:127-154 semantics).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beforeholiday_tpu import amp
+from beforeholiday_tpu.optimizers import FusedAdam, FusedSGD
+
+
+def _mlp_params(key, d=16):
+    k1, k2 = jax.random.split(key)
+    return {
+        "dense1": {"w": jax.random.normal(k1, (d, d)) * 0.3, "b": jnp.zeros((d,))},
+        "norm": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "dense2": {"w": jax.random.normal(k2, (d, 1)) * 0.3, "b": jnp.zeros((1,))},
+    }
+
+
+def _mlp_apply(params, x):
+    h = x @ params["dense1"]["w"] + params["dense1"]["b"]
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    h = (h - mu) * jax.lax.rsqrt(var + 1e-5)
+    h = h * params["norm"]["scale"] + params["norm"]["bias"]
+    h = jax.nn.relu(h)
+    return h @ params["dense2"]["w"] + params["dense2"]["b"]
+
+
+class TestOptLevels:
+    """Dtype outcomes per opt level (ref: apex/amp/frontend.py:70-247)."""
+
+    def test_unknown_level_raises(self):
+        with pytest.raises(RuntimeError, match="Unexpected optimization level"):
+            amp.initialize(_mlp_apply, _mlp_params(jax.random.PRNGKey(0)), opt_level="O9")
+
+    def test_o0_fp32_everything(self):
+        m = amp.initialize(_mlp_apply, _mlp_params(jax.random.PRNGKey(0)), opt_level="O0")
+        assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(m.params))
+        assert not m.scaler.dynamic and m.scaler.init()["scale"] == 1.0
+
+    def test_o1_fp32_storage_fp16_compute(self):
+        params = _mlp_params(jax.random.PRNGKey(0))
+        m = amp.initialize(_mlp_apply, params, opt_level="O1", cast_model_outputs=None)
+        # storage untouched
+        assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(m.params))
+        # compute in fp16: output dtype reveals the cast when not recast
+        out = m.apply(m.params, jnp.ones((2, 16)))
+        assert out.dtype == jnp.float16
+        assert m.scaler.dynamic
+
+    def test_o2_fp16_weights_fp32_norms_master(self):
+        params = _mlp_params(jax.random.PRNGKey(0))
+        opt = FusedSGD(lr=0.1, impl="jnp")
+        m = amp.initialize(_mlp_apply, params, opt, opt_level="O2")
+        assert m.params["dense1"]["w"].dtype == jnp.float16
+        assert m.params["norm"]["scale"].dtype == jnp.float32  # keep_batchnorm_fp32
+        assert isinstance(m.optimizer, amp.MasterWeights)
+        state = m.optimizer.init(m.params)
+        assert state["master"]["dense1"]["w"].dtype == jnp.float32
+        assert m.scaler.dynamic
+
+    def test_o3_pure_fp16(self):
+        m = amp.initialize(_mlp_apply, _mlp_params(jax.random.PRNGKey(0)), opt_level="O3")
+        assert all(l.dtype == jnp.float16 for l in jax.tree.leaves(m.params))
+        assert not m.scaler.dynamic
+
+    def test_o4_bf16_compute_no_scaling(self):
+        m = amp.initialize(_mlp_apply, _mlp_params(jax.random.PRNGKey(0)),
+                           opt_level="O4", cast_model_outputs=None)
+        assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(m.params))
+        out = m.apply(m.params, jnp.ones((2, 16)))
+        assert out.dtype == jnp.bfloat16
+        assert not m.scaler.dynamic and m.scaler.init()["scale"] == 1.0
+
+    def test_o5_bf16_weights_master(self):
+        opt = FusedAdam(lr=1e-3, impl="jnp")
+        m = amp.initialize(_mlp_apply, _mlp_params(jax.random.PRNGKey(0)), opt, opt_level="O5")
+        assert m.params["dense1"]["w"].dtype == jnp.bfloat16
+        assert m.params["norm"]["scale"].dtype == jnp.float32
+        assert isinstance(m.optimizer, amp.MasterWeights)
+
+    def test_overrides_beat_opt_level(self):
+        # ref: frontend.py:347-390 explicit-kwarg override rule
+        m = amp.initialize(_mlp_apply, _mlp_params(jax.random.PRNGKey(0)),
+                           opt_level="O2", keep_batchnorm_fp32=False,
+                           master_weights=False, loss_scale=128.0)
+        assert m.params["norm"]["scale"].dtype == jnp.float16
+        assert m.optimizer is None
+        assert not m.scaler.dynamic and m.scaler.init()["scale"] == 128.0
+
+    def test_outputs_cast_to_fp32_by_default(self):
+        m = amp.initialize(_mlp_apply, _mlp_params(jax.random.PRNGKey(0)), opt_level="O3")
+        out = m.apply(m.params, jnp.ones((2, 16)))
+        assert out.dtype == jnp.float32
+
+
+class TestLossScaler:
+    def test_static_scale_never_moves(self):
+        s = amp.LossScaler(loss_scale=128.0)
+        st = s.init()
+        st = s.update(st, jnp.bool_(True))
+        assert float(st["scale"]) == 128.0
+
+    def test_dynamic_halves_on_overflow(self):
+        s = amp.LossScaler()
+        st = s.init()
+        assert float(st["scale"]) == 2.0**16
+        st = s.update(st, jnp.bool_(True))
+        assert float(st["scale"]) == 2.0**15
+        assert int(st["unskipped"]) == 0
+
+    def test_dynamic_doubles_after_window(self):
+        s = amp.LossScaler(scale_window=3)
+        st = s.init()
+        for _ in range(2):
+            st = s.update(st, jnp.bool_(False))
+            assert float(st["scale"]) == 2.0**16
+        st = s.update(st, jnp.bool_(False))
+        assert float(st["scale"]) == 2.0**17
+        assert int(st["unskipped"]) == 0
+
+    def test_overflow_resets_window(self):
+        s = amp.LossScaler(scale_window=3)
+        st = s.init()
+        st = s.update(st, jnp.bool_(False))
+        st = s.update(st, jnp.bool_(True))  # overflow resets counter
+        for _ in range(2):
+            st = s.update(st, jnp.bool_(False))
+        assert float(st["scale"]) == 2.0**15  # not yet re-grown
+        st = s.update(st, jnp.bool_(False))
+        assert float(st["scale"]) == 2.0**16
+
+    def test_max_scale_cap(self):
+        s = amp.LossScaler(scale_window=1, max_loss_scale=2.0**17)
+        st = s.init()
+        for _ in range(5):
+            st = s.update(st, jnp.bool_(False))
+        assert float(st["scale"]) == 2.0**17
+
+    def test_min_scale_floor(self):
+        s = amp.LossScaler(min_loss_scale=2.0**15)
+        st = s.init()
+        for _ in range(5):
+            st = s.update(st, jnp.bool_(True))
+        assert float(st["scale"]) == 2.0**15
+
+    def test_unscale_detects_inf_and_divides(self):
+        s = amp.LossScaler()
+        st = s.init()
+        grads = {"a": jnp.full((1024,), 2.0**16), "b": jnp.ones((512,))}
+        out, found = s.unscale(grads, st, impl="jnp")
+        assert not bool(found)
+        np.testing.assert_allclose(np.asarray(out["a"]), 1.0)
+        grads_bad = {"a": jnp.asarray([jnp.inf] + [1.0] * 1023), "b": jnp.ones((512,))}
+        _, found = s.unscale(grads_bad, st, impl="jnp")
+        assert bool(found)
+
+    def test_state_dict_roundtrip(self):
+        # ref: tests/L0/run_amp/test_checkpointing.py
+        s = amp.LossScaler(scale_window=2)
+        st = s.init()
+        st = s.update(st, jnp.bool_(True))
+        st = s.update(st, jnp.bool_(False))
+        blob = s.state_dict(st)
+        st2 = s.load_state_dict(blob)
+        assert float(st2["scale"]) == float(st["scale"])
+        assert int(st2["unskipped"]) == int(st["unskipped"])
+
+
+class TestScaledValueAndGrad:
+    def test_grads_match_unscaled(self):
+        params = {"w": jnp.asarray([1.0, 2.0, 3.0])}
+
+        def loss_fn(p):
+            return jnp.sum(p["w"] ** 2)
+
+        s = amp.LossScaler(loss_scale=1024.0)
+        st = s.init()
+        f = amp.scaled_value_and_grad(loss_fn, s, impl="jnp")
+        loss, grads, found, st2 = f(params, st)
+        assert not bool(found)
+        np.testing.assert_allclose(float(loss), 14.0, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads["w"]), [2.0, 4.0, 6.0], rtol=1e-5)
+
+    def test_overflow_detected_and_scale_halved(self):
+        params = {"w": jnp.asarray([60000.0], jnp.float32)}
+
+        def loss_fn(p):
+            # fp16 grads of scale*loss overflow: d/dw (w^2) * scale = huge
+            return jnp.sum(p["w"].astype(jnp.float16) ** 2)
+
+        s = amp.LossScaler()  # 2^16 start
+        st = s.init()
+        f = amp.scaled_value_and_grad(loss_fn, s, impl="jnp")
+        loss, grads, found, st2 = f(params, st)
+        assert bool(found)
+        assert float(st2["scale"]) == 2.0**15
+
+    def test_jit_end_to_end_skip_semantics(self):
+        """Toy O2-style loop: overflow steps are skipped, scale recovers.
+
+        The 'Done =' oracle from VERDICT item 3: injected overflow steps
+        demonstrably skipped and scale halved, all under one jit.
+        """
+        params = {"w": jnp.ones((64,), jnp.float32)}
+        opt = FusedAdam(lr=0.1, impl="jnp")
+        scaler = amp.LossScaler(scale_window=100)
+        opt_state = opt.init(params)
+        sstate = scaler.init()
+
+        def loss_fn(p, inject_inf):
+            base = jnp.sum(p["w"] ** 2)
+            # multiplicative inf so the overflow reaches the *gradients*
+            return base * jnp.where(inject_inf, jnp.inf, 1.0)
+
+        @jax.jit
+        def step(params, opt_state, sstate, inject):
+            f = amp.scaled_value_and_grad(loss_fn, scaler, impl="jnp")
+            loss, grads, found, sstate = f(params, sstate, inject)
+            params, opt_state = opt.step(params, grads, opt_state, found_inf=found)
+            return params, opt_state, sstate, found
+
+        p0 = params
+        params, opt_state, sstate, found = step(params, opt_state, sstate, jnp.bool_(True))
+        assert bool(found)
+        np.testing.assert_array_equal(np.asarray(params["w"]), np.asarray(p0["w"]))
+        assert float(sstate["scale"]) == 2.0**15
+        assert int(opt_state["step"]) == 0
+
+        params, opt_state, sstate, found = step(params, opt_state, sstate, jnp.bool_(False))
+        assert not bool(found)
+        assert not np.allclose(np.asarray(params["w"]), np.asarray(p0["w"]))
+        assert int(opt_state["step"]) == 1
+
+
+class TestMasterWeightsTraining:
+    def test_o2_style_training_converges_fp16(self):
+        key = jax.random.PRNGKey(0)
+        params = _mlp_params(key)
+        x = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+        y = jnp.sum(x * 0.1, axis=-1, keepdims=True)
+
+        opt = FusedAdam(lr=1e-2, impl="jnp")
+        m = amp.initialize(_mlp_apply, params, opt, opt_level="O2")
+        opt_state = m.optimizer.init(m.params)
+        sstate = m.scaler.init()
+
+        def loss_fn(p):
+            pred = m.apply(p, x)
+            return jnp.mean((pred - y) ** 2)
+
+        @jax.jit
+        def step(p, os, ss):
+            f = amp.scaled_value_and_grad(loss_fn, m.scaler, impl="jnp")
+            loss, grads, found, ss = f(p, ss)
+            p, os = m.optimizer.step(p, grads, os, found_inf=found)
+            return loss, p, os, ss
+
+        p = m.params
+        losses = []
+        for _ in range(60):
+            loss, p, opt_state, sstate = step(p, opt_state, sstate)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.3, losses[::20]
+        # model stays fp16, master stays fp32
+        assert p["dense1"]["w"].dtype == jnp.float16
+        assert opt_state["master"]["dense1"]["w"].dtype == jnp.float32
+
+    def test_master_params_iterator(self):
+        params = _mlp_params(jax.random.PRNGKey(0))
+        opt = FusedSGD(lr=0.1, impl="jnp")
+        m = amp.initialize(_mlp_apply, params, opt, opt_level="O2")
+        st = m.optimizer.init(m.params)
+        masters = m.optimizer.master_params(st)
+        assert all(mm.dtype == jnp.float32 for mm in masters)
+        assert len(masters) == len(jax.tree.leaves(params))
+
+    def test_amp_model_state_dict_roundtrip(self):
+        m = amp.initialize(_mlp_apply, _mlp_params(jax.random.PRNGKey(0)), opt_level="O2")
+        ss = m.scaler.init()
+        ss = m.scaler.update(ss, jnp.bool_(True))
+        blob = m.state_dict(ss)
+        ss2 = m.load_state_dict(blob)
+        assert float(ss2["scale"]) == float(ss["scale"])
