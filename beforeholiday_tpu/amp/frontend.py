@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from beforeholiday_tpu.amp.scaler import LossScaler
+from beforeholiday_tpu.optimizers.fused import MasterWeights
 from beforeholiday_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -109,9 +110,7 @@ def _cast_params(params, policy: Properties, keep_fp32_mask):
             out.append(leaf.astype(target))
         else:
             out.append(leaf)
-    return jax.tree_util.tree_unflatten(
-        jax.tree_util.tree_structure(params), out
-    )
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _cast_floats(tree, dtype):
@@ -121,40 +120,6 @@ def _cast_floats(tree, dtype):
         else x,
         tree,
     )
-
-
-class MasterWeights:
-    """fp32 master-weight optimizer wrapper (ref: apex/amp/_process_optimizer.py:321-489).
-
-    ``init`` snapshots fp32 masters from the (possibly low-precision) model
-    params; ``step`` updates the masters with fp32 grads and re-casts into each
-    model leaf's dtype — the reference's lazy master creation +
-    ``_master_params_to_model_params`` copy (:14-25), made explicit.
-    """
-
-    def __init__(self, inner):
-        self.inner = inner
-
-    def init(self, params):
-        master = _cast_floats(params, jnp.float32)
-        return {"inner": self.inner.init(master), "master": master}
-
-    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
-        master = state["master"]
-        grads32 = _cast_floats(grads, jnp.float32)
-        new_master, new_inner = self.inner.step(
-            master, grads32, state["inner"],
-            found_inf=found_inf, grad_scale=grad_scale, **kw,
-        )
-        new_params = jax.tree.map(
-            lambda m, p: m.astype(p.dtype) if hasattr(p, "dtype") else m,
-            new_master, params,
-        )
-        return new_params, {"inner": new_inner, "master": new_master}
-
-    def master_params(self, state):
-        """Iterator over master leaves (ref: apex/amp/_amp_state.py master_params)."""
-        return jax.tree_util.tree_leaves(state["master"])
 
 
 @dataclasses.dataclass

@@ -4,6 +4,11 @@
 * ``multi_tensor`` — the multi-tensor-apply family (``amp_C``): scale, axpby,
   l2norm, adam, sgd, lamb, novograd, adagrad, lars, with device-side overflow
   semantics.
+* ``normalization`` — fused LayerNorm/RMSNorm incl. mixed-dtype-output variants
+  (``fused_layer_norm_cuda``).
+* ``softmax`` — the scaled/masked softmax family (4 megatron kernels).
+* ``dense`` — fused dense / GELU-epilogue dense / whole-MLP chains
+  (``fused_dense_cuda``, ``mlp_cuda``) — XLA-epilogue-fused by construction.
 """
 
 from .arena import ArenaSpec, flatten, make_spec, unflatten  # noqa: F401
@@ -17,4 +22,22 @@ from .multi_tensor import (  # noqa: F401
     multi_tensor_novograd,
     multi_tensor_scale,
     multi_tensor_sgd,
+)
+from .normalization import (  # noqa: F401
+    fused_layer_norm,
+    fused_rms_norm,
+    mixed_dtype_fused_layer_norm,
+    mixed_dtype_fused_rms_norm,
+)
+from .softmax import (  # noqa: F401
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from .dense import (  # noqa: F401
+    fused_dense,
+    fused_dense_gelu_dense,
+    init_mlp_params,
+    mlp,
 )

@@ -1,0 +1,91 @@
+"""Fused dense and MLP blocks (ref: csrc/fused_dense_cuda.cu, csrc/mlp_cuda.cu).
+
+The reference drives cublasLt epilogue fusion: GEMM+bias, GEMM+bias+GELU, and a
+whole-MLP forward/backward chain with fused bias/ReLU/sigmoid epilogues
+(ref: csrc/fused_dense_cuda.cu:130-214, csrc/mlp_cuda.cu:63-158). On TPU the
+MXU epilogue fusion is XLA's job: a jnp matmul followed by bias/activation is
+compiled into one fused HLO, so these are thin, *contractually fused* wrappers
+— the parity surface of ``apex.fused_dense``/``apex.mlp`` — not Pallas kernels.
+bf16 inputs hit the MXU with fp32 accumulation (``preferred_element_type``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _matmul(x, w):
+    # fp32 MXU accumulation regardless of input dtype
+    return jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def fused_dense(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None):
+    """GEMM + bias epilogue (ref: fused_dense_cuda.cu linear_bias_forward).
+
+    x: (..., in); weight: (in, out); bias: (out,). Output in x.dtype.
+    """
+    y = _matmul(x, weight)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def fused_dense_gelu_dense(
+    x: jax.Array,
+    weight1: jax.Array,
+    bias1: jax.Array,
+    weight2: jax.Array,
+    bias2: jax.Array,
+):
+    """GEMM+bias+GELU+GEMM+bias chain (ref: fused_dense_cuda.cu
+    linear_gelu_linear_forward). The intermediate GELU is tanh-approximate,
+    matching the reference's epilogue (CUBLASLT_EPILOGUE_GELU)."""
+    h = _matmul(x, weight1) + bias1.astype(jnp.float32)
+    h = jax.nn.gelu(h, approximate=True)
+    y = _matmul(h.astype(x.dtype), weight2) + bias2.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def mlp(
+    x: jax.Array,
+    weights: Sequence[jax.Array],
+    biases: Sequence[jax.Array],
+    activation: str = "relu",
+):
+    """Whole-MLP fused chain (ref: csrc/mlp_cuda.cu, apex/mlp/mlp.py:26 MLP).
+
+    weights[i]: (in_i, out_i); activation applied between layers but not after
+    the last, exactly as the reference ('none' | 'relu' | 'sigmoid').
+    """
+    if len(weights) != len(biases):
+        raise ValueError("weights and biases must pair up")
+    acts = {"none": lambda h: h, "relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid}
+    if activation not in acts:
+        raise ValueError(f"activation must be one of {sorted(acts)}, got {activation!r}")
+    act = acts[activation]
+    h = x
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        h = _matmul(h, w) + b.astype(jnp.float32)
+        if i + 1 < len(weights):
+            h = act(h)
+        h = h.astype(x.dtype)
+    return h
+
+
+def init_mlp_params(
+    key: jax.Array, sizes: Sequence[int], dtype=jnp.float32
+) -> Tuple[list, list]:
+    """Convenience init matching apex.mlp.MLP(mlp_sizes) — returns (weights, biases)."""
+    weights, biases = [], []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (din, dout) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        # torch Linear default init: U(-1/sqrt(in), 1/sqrt(in))
+        bound = 1.0 / jnp.sqrt(jnp.float32(din))
+        weights.append(jax.random.uniform(k, (din, dout), dtype, -bound, bound))
+        biases.append(jnp.zeros((dout,), dtype))
+    return weights, biases

@@ -1,0 +1,227 @@
+"""Fused scaled/masked softmax family (ref: csrc/megatron/*.h, 4 CUDA modules).
+
+The reference fuses scale → mask → softmax (and the matching backward) for
+attention scores, with four variants registered as separate extensions
+(setup.py:422-484):
+
+* ``scaled_upper_triang_masked_softmax`` — causal, input (b, sq, sk)
+* ``scaled_masked_softmax``              — explicit mask, input (b, np, sq, sk),
+  mask (b, 1, sq, sk) broadcast over heads, mask==1 → masked out
+* ``generic_scaled_masked_softmax``      — arbitrary-shape variant
+* ``scaled_softmax``                     — scale only, no mask
+
+TPU design: one Pallas row-block kernel with an iota-generated causal mode (no
+mask tensor in HBM); the explicit-mask variants fill outside the kernel so XLA
+fuses the (b,1,sq,sk)->(b,np,sq,sk) head broadcast. Backward is the standard
+softmax VJP ``scale * y * (dy - sum(dy*y))`` fused into one kernel. All math
+fp32 (the reference dispatches fp16/bf16 in, fp32 accumulate).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_MASK_VALUE = -10000.0  # ref: scaled_masked_softmax.h additive mask fill
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _resolve_impl(impl: Optional[str]) -> str:
+    if impl is None:
+        # pallas_call is an opaque custom call to the GSPMD partitioner: under
+        # a >1-device mesh it would force replication/all-gathers on sharded
+        # activations. Default to pallas only single-device; the jnp path
+        # partitions transparently. Explicit impl="pallas" is always honored.
+        impl = (
+            "pallas"
+            if jax.default_backend() == "tpu" and jax.device_count() == 1
+            else "jnp"
+        )
+    if impl not in ("pallas", "jnp"):
+        raise ValueError(f"impl must be 'pallas' or 'jnp', got {impl!r}")
+    return impl
+
+
+# ---------------------------------------------------------------------------------
+# kernels: grid over row blocks of a (rows, sk) view; causal needs the absolute
+# query index, recovered from program_id
+# ---------------------------------------------------------------------------------
+
+_BR = 128  # query rows per grid step
+
+
+def _softmax_fwd_kernel(causal, sq, scal_ref, x_ref, y_ref):
+    scale = scal_ref[0, 0]
+    x = x_ref[...].astype(jnp.float32) * scale
+    if causal:
+        # absolute query row of each tile row; key index from iota over sk
+        row0 = (pl.program_id(0) * _BR) % sq
+        q = row0 + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+        k = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(k > q, _MASK_VALUE, x)
+    x = x - jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x)
+    y = e / jnp.sum(e, axis=-1, keepdims=True)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _softmax_bwd_kernel(scal_ref, y_ref, dy_ref, dx_ref):
+    scale = scal_ref[0, 0]
+    y = y_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    dx = scale * y * (dy - jnp.sum(dy * y, axis=-1, keepdims=True))
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _pad_rows(x2d):
+    rows = x2d.shape[0]
+    padded = ((rows + _BR - 1) // _BR) * _BR
+    if padded != rows:
+        x2d = jnp.pad(x2d, ((0, padded - rows), (0, 0)))
+    return x2d, rows
+
+
+def _fwd_pallas(x2d, scale, causal, sq, out_dtype, interpret):
+    sk = x2d.shape[-1]
+    xp, rows = _pad_rows(x2d)
+    grid = xp.shape[0] // _BR
+    smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    row_spec = pl.BlockSpec((_BR, sk), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    y = pl.pallas_call(
+        functools.partial(_softmax_fwd_kernel, causal, sq),
+        grid=(grid,),
+        in_specs=[smem, row_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct(xp.shape, out_dtype),
+        interpret=interpret,
+    )(jnp.asarray([[scale]], jnp.float32), xp)
+    return y[:rows]
+
+
+def _bwd_pallas(y2d, dy2d, scale, interpret):
+    sk = y2d.shape[-1]
+    yp, rows = _pad_rows(y2d)
+    dyp, _ = _pad_rows(dy2d)
+    grid = yp.shape[0] // _BR
+    smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    row_spec = pl.BlockSpec((_BR, sk), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    dx = pl.pallas_call(
+        _softmax_bwd_kernel,
+        grid=(grid,),
+        in_specs=[smem, row_spec, row_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct(yp.shape, dy2d.dtype),
+        interpret=interpret,
+    )(jnp.asarray([[scale]], jnp.float32), yp, dyp)
+    return dx[:rows]
+
+
+# ---------------------------------------------------------------------------------
+# jnp oracle
+# ---------------------------------------------------------------------------------
+
+
+def _fwd_jnp(x2d, scale, causal, sq, out_dtype):
+    x = x2d.astype(jnp.float32) * scale
+    if causal:
+        rows, sk = x.shape
+        q = jnp.arange(rows)[:, None] % sq
+        k = jnp.arange(sk)[None, :]
+        x = jnp.where(k > q, _MASK_VALUE, x)
+    return jax.nn.softmax(x, axis=-1).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------------
+# custom VJP core over a 2D (rows, sk) view
+# ---------------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _softmax2d(x2d, scale, causal, sq, impl):
+    if impl == "pallas":
+        return _fwd_pallas(x2d, scale, causal, sq, x2d.dtype, _interpret_default())
+    return _fwd_jnp(x2d, scale, causal, sq, x2d.dtype)
+
+
+def _softmax2d_fwd(x2d, scale, causal, sq, impl):
+    y = _softmax2d(x2d, scale, causal, sq, impl)
+    return y, y
+
+
+def _softmax2d_bwd(scale, causal, sq, impl, y, dy):
+    if impl == "pallas":
+        dx = _bwd_pallas(y, dy, scale, _interpret_default())
+    else:
+        yf = y.astype(jnp.float32)
+        dyf = dy.astype(jnp.float32)
+        dx = (scale * yf * (dyf - jnp.sum(dyf * yf, axis=-1, keepdims=True))).astype(dy.dtype)
+    return (dx,)
+
+
+_softmax2d.defvjp(_softmax2d_fwd, _softmax2d_bwd)
+
+
+# ---------------------------------------------------------------------------------
+# public API — the four reference entry points
+# ---------------------------------------------------------------------------------
+
+
+def scaled_softmax(x: jax.Array, scale: float = 1.0, *, impl: Optional[str] = None):
+    """softmax(scale*x) over the last dim (ref: scaled_softmax_cuda)."""
+    impl = _resolve_impl(impl)
+    sk = x.shape[-1]
+    y = _softmax2d(x.reshape(-1, sk), float(scale), False, 0, impl)
+    return y.reshape(x.shape)
+
+
+def scaled_masked_softmax(
+    x: jax.Array, mask: jax.Array, scale: float = 1.0, *, impl: Optional[str] = None
+):
+    """softmax(scale*x masked) (ref: scaled_masked_softmax_cuda).
+
+    x: (b, np, sq, sk); mask: (b, 1, sq, sk) or broadcastable, nonzero = mask out
+    (filled with -10000 pre-softmax, the reference's additive fill). The fill
+    happens outside the kernel so XLA fuses the head-broadcast — the mask is
+    streamed once per (b, sq, sk), never materialized per head.
+    """
+    impl = _resolve_impl(impl)
+    sk = x.shape[-1]
+    filled = jnp.where(mask != 0, _MASK_VALUE, x.astype(jnp.float32) * scale)
+    y = _softmax2d(filled.reshape(-1, sk), 1.0, False, 0, impl)
+    return y.astype(x.dtype).reshape(x.shape)
+
+
+def generic_scaled_masked_softmax(
+    x: jax.Array, mask: jax.Array, scale: float = 1.0, *, impl: Optional[str] = None
+):
+    """Arbitrary-shape scale+mask+softmax (ref: generic_scaled_masked_softmax_cuda).
+    Same math as scaled_masked_softmax without the 4D shape contract."""
+    return scaled_masked_softmax(x, mask, scale, impl=impl)
+
+
+def scaled_upper_triang_masked_softmax(
+    x: jax.Array, scale: float = 1.0, *, impl: Optional[str] = None
+):
+    """Causal softmax(scale*x) (ref: scaled_upper_triang_masked_softmax_cuda).
+
+    x: (attn_batches, sq, sk) with sq == sk (self-attention scores). The causal
+    mask is generated in-kernel from iota — no mask tensor traffic.
+    """
+    impl = _resolve_impl(impl)
+    b, sq, sk = x.shape
+    if sq != sk:
+        raise ValueError(f"causal softmax expects square scores, got sq={sq} sk={sk}")
+    if impl == "pallas" and sq % _BR != 0:
+        # tile rows must align with the sequence so program_id recovers the
+        # absolute query index; fall back for ragged sizes
+        impl = "jnp"
+    y = _softmax2d(x.reshape(-1, sk), float(scale), True, sq, impl)
+    return y.reshape(x.shape)

@@ -1,6 +1,7 @@
 """Fused optimizers (ref: apex/optimizers/ + apex/contrib/optimizers/)."""
 
 from beforeholiday_tpu.optimizers.fused import (  # noqa: F401
+    MasterWeights,
     FusedAdagrad,
     FusedAdam,
     FusedLAMB,

@@ -50,10 +50,12 @@ def _leaf_flags(mask: Mask, params) -> List[bool]:
 
 def _buckets(pleaves, gleaves, nowd_flags) -> Dict[tuple, List[int]]:
     # zip() would silently drop trailing leaves on a malformed grads tree,
-    # freezing those params for the whole run — fail loudly instead
-    assert len(pleaves) == len(gleaves) == len(nowd_flags), (
-        f"params/grads leaf mismatch: {len(pleaves)} vs {len(gleaves)}"
-    )
+    # freezing those params for the whole run — fail loudly (not assert: -O
+    # must not restore the silent truncation)
+    if not (len(pleaves) == len(gleaves) == len(nowd_flags)):
+        raise ValueError(
+            f"params/grads leaf mismatch: {len(pleaves)} vs {len(gleaves)}"
+        )
     out: Dict[tuple, List[int]] = {}
     for i, (p, g, nowd) in enumerate(zip(pleaves, gleaves, nowd_flags)):
         out.setdefault((p.dtype, g.dtype, nowd), []).append(i)
@@ -334,16 +336,10 @@ class FusedLAMB(_FusedOptimizer):
         # grad_scale may be a traced scalar (amp inverse loss scale) — never
         # branch on it; fold it in unconditionally
         gleaves = [g.astype(jnp.float32) * grad_scale for g in gleaves]
-        # global grad norm across ALL buckets before per-bucket updates
-        # (ref: fused_lamb.py:124-147 multi_tensor_l2norm over both dtype lists)
-        by_dtype: Dict[Any, List[int]] = {}
-        for i, g in enumerate(gleaves):
-            by_dtype.setdefault(g.dtype, []).append(i)
-        sumsq = jnp.float32(0.0)
-        for dt, didx in by_dtype.items():
-            n, _ = mt.multi_tensor_l2norm(_gather(gleaves, didx), impl=self.impl)
-            sumsq = sumsq + n * n
-        gnorm = jnp.sqrt(sumsq)
+        # global grad norm across ALL buckets before per-bucket updates; one
+        # arena reduction — gleaves are uniformly fp32 after the scale fold
+        # (ref: fused_lamb.py:124-147 multi_tensor_l2norm over the full list)
+        gnorm, _ = mt.multi_tensor_l2norm(gleaves, impl=self.impl)
 
         new_p, new_m, new_v = list(pleaves), list(mleaves), list(vleaves)
         for (pd, gd, no_decay), idx in _buckets(pleaves, gleaves, nowd).items():
@@ -499,15 +495,56 @@ class FusedLARS(_FusedOptimizer):
         return unflat(new_p), {"momentum_buffer": unflat(new_b), "step": step_no}
 
 
-class FusedMixedPrecisionLamb(_FusedOptimizer):
-    """LAMB over fp32 master state with low-precision model params
-    (ref: apex/optimizers/fused_mixed_precision_lamb.py:8).
+def _cast_floats(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
 
-    ``init`` snapshots fp32 masters from the (bf16/fp16) model params; ``step``
-    updates the masters and re-emits model params in the model dtype. ``step``
-    accepts the amp scaler's ``grad_scale``/``found_inf`` directly, like the
-    reference's ``step(grad_scaler=...)`` (:140).
+
+class MasterWeights:
+    """fp32 master-weight optimizer wrapper (ref: apex/amp/_process_optimizer.py:321-489).
+
+    ``init`` snapshots fp32 masters from the (possibly low-precision) model
+    params; ``step`` updates the masters with fp32 grads and re-casts into each
+    model leaf's dtype — the reference's lazy master creation +
+    ``_master_params_to_model_params`` copy (:14-25), made explicit. Wraps any
+    fused optimizer; used by amp O2/O5 and FusedMixedPrecisionLamb.
     """
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def init(self, params):
+        master = _cast_floats(params, jnp.float32)
+        return {"inner": self.inner.init(master), "master": master}
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
+        master = state["master"]
+        grads32 = _cast_floats(grads, jnp.float32)
+        new_master, new_inner = self.inner.step(
+            master, grads32, state["inner"],
+            found_inf=found_inf, grad_scale=grad_scale, **kw,
+        )
+        new_params = jax.tree.map(
+            lambda m, p: m.astype(p.dtype) if hasattr(p, "dtype") else m,
+            new_master, params,
+        )
+        return new_params, {"inner": new_inner, "master": new_master}
+
+    def master_params(self, state):
+        """Iterator over master leaves (ref: apex/amp/_amp_state.py master_params)."""
+        return jax.tree_util.tree_leaves(state["master"])
+
+
+class FusedMixedPrecisionLamb(MasterWeights):
+    """LAMB over fp32 master state with low-precision model params
+    (ref: apex/optimizers/fused_mixed_precision_lamb.py:8) — exactly
+    ``MasterWeights(FusedLAMB(...))``; ``step`` accepts the amp scaler's
+    ``grad_scale``/``found_inf`` directly, like the reference's
+    ``step(grad_scaler=...)`` (:140)."""
 
     def __init__(
         self,
@@ -523,32 +560,10 @@ class FusedMixedPrecisionLamb(_FusedOptimizer):
         no_weight_decay_mask: Mask = None,
         impl: Optional[str] = None,
     ):
-        super().__init__(state_dtype=jnp.float32, no_weight_decay_mask=no_weight_decay_mask)
-        self._lamb = FusedLAMB(
+        super().__init__(FusedLAMB(
             lr, betas, eps, weight_decay=weight_decay,
             bias_correction=bias_correction, grad_averaging=grad_averaging,
             adam_w_mode=True, max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb,
             no_weight_decay_mask=no_weight_decay_mask, impl=impl,
-        )
+        ))
         self.lr = lr
-
-    def init(self, params):
-        master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
-        state = self._lamb.init(master)
-        state["master"] = master
-        return state
-
-    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
-        master = state["master"]
-        grads32 = jax.tree.map(
-            lambda g: g.astype(jnp.float32) * grad_scale, grads
-        )
-        inner = {k: state[k] for k in ("exp_avg", "exp_avg_sq", "step")}
-        new_master, new_inner = self._lamb.step(
-            master, grads32, inner, found_inf=found_inf, lr=lr
-        )
-        new_params = jax.tree.map(
-            lambda m, p: m.astype(p.dtype), new_master, params
-        )
-        new_inner["master"] = new_master
-        return new_params, new_inner

@@ -128,35 +128,38 @@ def _constrain(x, spec: P):
     return x
 
 
-def _layernorm(x, scale, bias, eps=1e-5):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
-    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
-    return (y * scale + bias).astype(x.dtype)
+def _layernorm(x, scale, bias):
+    # params may be fp32 under an amp policy while activations are bf16 —
+    # passed through uncast: the fused kernel computes in fp32 internally, so
+    # fp32 gamma/beta keep their full precision (keep_batchnorm_fp32 intact)
+    from beforeholiday_tpu.ops import fused_layer_norm
+
+    return fused_layer_norm(x, scale, bias)
 
 
 def _block(cfg: GPTConfig, x, lp):
-    """One transformer block. x: (B, S, D)."""
+    """One transformer block over the fused-ops layer. x: (B, S, D)."""
+    from beforeholiday_tpu.ops import fused_dense, scaled_upper_triang_masked_softmax
+
     B, S, D = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
 
     h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
-    qkv = h @ lp["wqkv"].astype(h.dtype) + lp["bqkv"].astype(h.dtype)
+    qkv = fused_dense(h, lp["wqkv"].astype(h.dtype), lp["bqkv"].astype(h.dtype))
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    scores = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(hd).astype(np.float32)
-    causal = jnp.tril(jnp.ones((S, S), bool))
-    scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    scores = (q @ k.transpose(0, 1, 3, 2)).reshape(B * H, S, S)
+    probs = scaled_upper_triang_masked_softmax(
+        scores, 1.0 / np.sqrt(hd)
+    ).astype(x.dtype).reshape(B, H, S, S)
     ctx = (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, D)
-    x = x + ctx @ lp["wo"].astype(x.dtype) + lp["bo"].astype(x.dtype)
+    x = x + fused_dense(ctx, lp["wo"].astype(x.dtype), lp["bo"].astype(x.dtype))
 
     h = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
-    h = jax.nn.gelu(h @ lp["wi"].astype(h.dtype) + lp["bi"].astype(h.dtype))
-    x = x + h @ lp["wo2"].astype(x.dtype) + lp["bo2"].astype(x.dtype)
+    h = jax.nn.gelu(fused_dense(h, lp["wi"].astype(h.dtype), lp["bi"].astype(h.dtype)))
+    x = x + fused_dense(h, lp["wo2"].astype(x.dtype), lp["bo2"].astype(x.dtype))
     return x
 
 

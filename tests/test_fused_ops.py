@@ -1,0 +1,277 @@
+"""Fused ops parity: LayerNorm/RMSNorm vs torch, softmax family vs jnp oracle.
+
+Ports of the reference's test strategy: run_fused_layer_norm compares against
+torch.nn.LayerNorm (tests/L0/run_fused_layer_norm), test_fused_softmax compares
+kernels against forward_torch_softmax (tests/L0/run_transformer/test_fused_softmax.py).
+Both impls ("pallas" interpreter, "jnp") are exercised on every case; grads go
+through the custom VJP.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from beforeholiday_tpu.ops import (
+    fused_dense,
+    fused_dense_gelu_dense,
+    fused_layer_norm,
+    fused_rms_norm,
+    generic_scaled_masked_softmax,
+    init_mlp_params,
+    mixed_dtype_fused_layer_norm,
+    mixed_dtype_fused_rms_norm,
+    mlp,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+IMPLS = ["jnp", "pallas"]
+
+
+class TestFusedLayerNorm:
+    @pytest.mark.parametrize("impl", IMPLS)
+    @pytest.mark.parametrize("shape,hidden", [((4, 7, 96), 96), ((640, 256), 256)])
+    def test_matches_torch(self, impl, shape, hidden):
+        rng = np.random.RandomState(0)
+        x = rng.randn(*shape).astype(np.float32)
+        w = rng.randn(hidden).astype(np.float32)
+        b = rng.randn(hidden).astype(np.float32)
+
+        got = fused_layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), impl=impl)
+        tln = torch.nn.functional.layer_norm(
+            torch.tensor(x), (hidden,), torch.tensor(w), torch.tensor(b), eps=1e-5
+        )
+        np.testing.assert_allclose(np.asarray(got), tln.numpy(), rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_grads_match_torch(self, impl):
+        rng = np.random.RandomState(1)
+        x = rng.randn(33, 96).astype(np.float32)
+        w = rng.randn(96).astype(np.float32)
+        b = rng.randn(96).astype(np.float32)
+
+        def loss(x_, w_, b_):
+            return jnp.sum(fused_layer_norm(x_, w_, b_, impl=impl) ** 2)
+
+        gx, gw, gb = jax.grad(loss, argnums=(0, 1, 2))(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)
+        )
+
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(w, requires_grad=True)
+        tb = torch.tensor(b, requires_grad=True)
+        tout = torch.nn.functional.layer_norm(tx, (96,), tw, tb, eps=1e-5)
+        (tout**2).sum().backward()
+        np.testing.assert_allclose(np.asarray(gx), tx.grad.numpy(), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(gw), tw.grad.numpy(), rtol=2e-4, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(gb), tb.grad.numpy(), rtol=2e-4, atol=2e-3)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_no_bias(self, impl):
+        rng = np.random.RandomState(2)
+        x = rng.randn(16, 128).astype(np.float32)
+        w = rng.randn(128).astype(np.float32)
+        got = fused_layer_norm(jnp.asarray(x), jnp.asarray(w), impl=impl)
+        tln = torch.nn.functional.layer_norm(
+            torch.tensor(x), (128,), torch.tensor(w), None, eps=1e-5
+        )
+        np.testing.assert_allclose(np.asarray(got), tln.numpy(), rtol=2e-5, atol=2e-5)
+
+    def test_pallas_matches_jnp_bf16(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(64, 256), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(256), jnp.bfloat16)
+        b = jnp.asarray(rng.randn(256), jnp.bfloat16)
+        a = fused_layer_norm(x, w, b, impl="pallas")
+        c = fused_layer_norm(x, w, b, impl="jnp")
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(c, np.float32), rtol=2e-2, atol=2e-2
+        )
+
+    def test_mixed_dtype_output_follows_params(self):
+        # ref: csrc/layer_norm_cuda.cpp:434 — bf16 input, fp32 params, fp32 out
+        x = jnp.ones((8, 128), jnp.bfloat16)
+        w = jnp.ones((128,), jnp.float32)
+        b = jnp.zeros((128,), jnp.float32)
+        out = mixed_dtype_fused_layer_norm(x, w, b, impl="jnp")
+        assert out.dtype == jnp.float32
+        out2 = fused_layer_norm(x, w.astype(jnp.bfloat16), b.astype(jnp.bfloat16), impl="jnp")
+        assert out2.dtype == jnp.bfloat16
+
+
+class TestFusedRMSNorm:
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_matches_manual(self, impl):
+        rng = np.random.RandomState(4)
+        x = rng.randn(40, 192).astype(np.float32)
+        w = rng.randn(192).astype(np.float32)
+        got = fused_rms_norm(jnp.asarray(x), jnp.asarray(w), impl=impl)
+        want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-5) * w
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_grads_match_jax_autodiff(self, impl):
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(24, 96), jnp.float32)
+        w = jnp.asarray(rng.randn(96), jnp.float32)
+
+        def manual(x_, w_):
+            n = x_ / jnp.sqrt(jnp.mean(x_**2, -1, keepdims=True) + 1e-5)
+            return jnp.sum((n * w_) ** 2)
+
+        def ours(x_, w_):
+            return jnp.sum(fused_rms_norm(x_, w_, impl=impl) ** 2)
+
+        gx0, gw0 = jax.grad(manual, (0, 1))(x, w)
+        gx1, gw1 = jax.grad(ours, (0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx0), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw0), rtol=2e-4, atol=2e-3)
+
+    def test_mixed_dtype(self):
+        x = jnp.ones((8, 128), jnp.bfloat16)
+        w = jnp.ones((128,), jnp.float32)
+        assert mixed_dtype_fused_rms_norm(x, w, impl="jnp").dtype == jnp.float32
+
+
+def _torch_softmax_ref(x, scale, mask=None, causal=False):
+    """The reference's forward_torch_softmax oracle
+    (tests/L0/run_transformer/test_fused_softmax.py)."""
+    t = torch.tensor(np.asarray(x, np.float32)) * scale
+    if mask is not None:
+        t = t.masked_fill(torch.tensor(np.asarray(mask)) != 0, -10000.0)
+    if causal:
+        sq, sk = t.shape[-2], t.shape[-1]
+        causal_mask = torch.triu(torch.ones(sq, sk, dtype=torch.bool), diagonal=1)
+        t = t.masked_fill(causal_mask, -10000.0)
+    return torch.softmax(t, dim=-1).numpy()
+
+
+class TestSoftmaxFamily:
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_scaled_softmax(self, impl):
+        rng = np.random.RandomState(6)
+        x = rng.randn(2, 4, 32, 160).astype(np.float32)
+        got = scaled_softmax(jnp.asarray(x), 0.5, impl=impl)
+        np.testing.assert_allclose(
+            np.asarray(got), _torch_softmax_ref(x, 0.5), rtol=2e-5, atol=2e-6
+        )
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_scaled_masked_softmax(self, impl):
+        rng = np.random.RandomState(7)
+        x = rng.randn(2, 3, 16, 48).astype(np.float32)
+        mask = (rng.rand(2, 1, 16, 48) > 0.7).astype(np.int8)
+        got = scaled_masked_softmax(jnp.asarray(x), jnp.asarray(mask), 2.0, impl=impl)
+        want = _torch_softmax_ref(x, 2.0, mask=np.broadcast_to(mask, x.shape))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_upper_triang(self, impl):
+        rng = np.random.RandomState(8)
+        x = rng.randn(6, 128, 128).astype(np.float32)
+        got = scaled_upper_triang_masked_softmax(jnp.asarray(x), 0.25, impl=impl)
+        want = _torch_softmax_ref(x, 0.25, causal=True)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-6)
+        # causal: strictly-upper entries are (near) zero
+        assert np.triu(np.asarray(got)[0], 1).max() < 1e-4
+
+    def test_upper_triang_ragged_seq_falls_back(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(2, 96, 96).astype(np.float32)  # 96 % 128 != 0
+        got = scaled_upper_triang_masked_softmax(jnp.asarray(x), 1.0, impl="pallas")
+        want = _torch_softmax_ref(x, 1.0, causal=True)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_generic_variant(self, impl):
+        rng = np.random.RandomState(10)
+        x = rng.randn(5, 48).astype(np.float32)
+        mask = (rng.rand(5, 48) > 0.5).astype(np.int8)
+        got = generic_scaled_masked_softmax(jnp.asarray(x), jnp.asarray(mask), 1.5, impl=impl)
+        want = _torch_softmax_ref(x, 1.5, mask=mask)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_bwd_matches_torch(self, impl):
+        rng = np.random.RandomState(11)
+        x = rng.randn(4, 128, 128).astype(np.float32)
+
+        def loss(x_):
+            return jnp.sum(scaled_upper_triang_masked_softmax(x_, 0.5, impl=impl) ** 2)
+
+        gx = jax.grad(loss)(jnp.asarray(x))
+
+        tx = torch.tensor(x, requires_grad=True)
+        t = tx * 0.5
+        cm = torch.triu(torch.ones(128, 128, dtype=torch.bool), diagonal=1)
+        t = t.masked_fill(cm, -10000.0)
+        (torch.softmax(t, -1) ** 2).sum().backward()
+        np.testing.assert_allclose(np.asarray(gx), tx.grad.numpy(), rtol=2e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_masked_bwd_no_mask_grad_leak(self, impl):
+        rng = np.random.RandomState(12)
+        x = jnp.asarray(rng.randn(2, 1, 8, 48), jnp.float32)
+        mask = jnp.asarray((rng.rand(2, 1, 8, 48) > 0.5), jnp.int8)
+
+        def loss(x_):
+            return jnp.sum(scaled_masked_softmax(x_, mask, 1.0, impl=impl))
+
+        gx = jax.grad(loss)(x)
+        assert np.all(np.isfinite(np.asarray(gx)))
+
+
+class TestFusedDense:
+    def test_dense_matches_jnp(self):
+        rng = np.random.RandomState(13)
+        x = jnp.asarray(rng.randn(8, 32), jnp.float32)
+        w = jnp.asarray(rng.randn(32, 16), jnp.float32)
+        b = jnp.asarray(rng.randn(16), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(fused_dense(x, w, b)), np.asarray(x @ w + b), rtol=1e-5
+        )
+
+    def test_gelu_dense_matches_torch(self):
+        rng = np.random.RandomState(14)
+        x = rng.randn(8, 32).astype(np.float32)
+        w1 = rng.randn(32, 64).astype(np.float32)
+        b1 = rng.randn(64).astype(np.float32)
+        w2 = rng.randn(64, 16).astype(np.float32)
+        b2 = rng.randn(16).astype(np.float32)
+        got = fused_dense_gelu_dense(*map(jnp.asarray, (x, w1, b1, w2, b2)))
+        h = torch.nn.functional.gelu(torch.tensor(x) @ torch.tensor(w1) + torch.tensor(b1),
+                                     approximate="tanh")
+        want = h @ torch.tensor(w2) + torch.tensor(b2)
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_mlp_matches_torch_chain(self):
+        # ref: apex/mlp/mlp.py MLP(mlp_sizes) with relu
+        rng = np.random.RandomState(15)
+        sizes = [24, 48, 16, 4]
+        weights, biases = init_mlp_params(jax.random.PRNGKey(0), sizes)
+        x = jnp.asarray(rng.randn(10, 24), jnp.float32)
+        got = mlp(x, weights, biases, activation="relu")
+
+        h = torch.tensor(np.asarray(x))
+        for i, (w, b) in enumerate(zip(weights, biases)):
+            h = h @ torch.tensor(np.asarray(w)) + torch.tensor(np.asarray(b))
+            if i + 1 < len(weights):
+                h = torch.relu(h)
+        np.testing.assert_allclose(np.asarray(got), h.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_mlp_bad_activation_raises(self):
+        weights, biases = init_mlp_params(jax.random.PRNGKey(0), [8, 8])
+        with pytest.raises(ValueError, match="activation"):
+            mlp(jnp.ones((2, 8)), weights, biases, activation="tanh")
+
+    def test_bf16_fp32_accumulation(self):
+        # bf16 inputs accumulate in fp32 on the MXU path
+        x = jnp.full((4, 512), 0.01, jnp.bfloat16)
+        w = jnp.full((512, 8), 0.01, jnp.bfloat16)
+        out = fused_dense(x, w)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32), 0.0512, rtol=2e-2)
