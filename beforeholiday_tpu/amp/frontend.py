@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from beforeholiday_tpu.amp.scaler import LossScaler
-from beforeholiday_tpu.optimizers.fused import MasterWeights
+from beforeholiday_tpu.optimizers.fused import MasterWeights, _cast_floats
 from beforeholiday_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -111,15 +111,6 @@ def _cast_params(params, policy: Properties, keep_fp32_mask):
         else:
             out.append(leaf)
     return jax.tree_util.tree_unflatten(treedef, out)
-
-
-def _cast_floats(tree, dtype):
-    return jax.tree.map(
-        lambda x: x.astype(dtype)
-        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
-        else x,
-        tree,
-    )
 
 
 @dataclasses.dataclass

@@ -1,0 +1,41 @@
+"""Shared Pallas dispatch policy and padding helpers for the fused-op kernels."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def interpret_default() -> bool:
+    """Pallas compiles natively on TPU; elsewhere the interpreter runs."""
+    return jax.default_backend() != "tpu"
+
+
+def resolve_impl(impl: Optional[str]) -> str:
+    """Pick the kernel implementation.
+
+    pallas_call is an opaque custom call to the GSPMD partitioner: under a
+    >1-device mesh it would force replication/all-gathers on sharded
+    activations. Default to pallas only single-device; the jnp path partitions
+    transparently. Explicit impl="pallas" is always honored.
+    """
+    if impl is None:
+        impl = (
+            "pallas"
+            if jax.default_backend() == "tpu" and jax.device_count() == 1
+            else "jnp"
+        )
+    if impl not in ("pallas", "jnp"):
+        raise ValueError(f"impl must be 'pallas' or 'jnp', got {impl!r}")
+    return impl
+
+
+def pad_rows(x2d: jax.Array, block_rows: int):
+    """Pad the leading dim to a multiple of block_rows. Returns (padded, rows)."""
+    rows = x2d.shape[0]
+    padded = ((rows + block_rows - 1) // block_rows) * block_rows
+    if padded != rows:
+        x2d = jnp.pad(x2d, ((0, padded - rows), (0, 0)))
+    return x2d, rows

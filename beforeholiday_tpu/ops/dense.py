@@ -80,12 +80,13 @@ def mlp(
 def init_mlp_params(
     key: jax.Array, sizes: Sequence[int], dtype=jnp.float32
 ) -> Tuple[list, list]:
-    """Convenience init matching apex.mlp.MLP(mlp_sizes) — returns (weights, biases)."""
+    """Init matching apex.mlp.MLP.reset_parameters (ref: apex/mlp/mlp.py:64-72):
+    weight ~ N(0, sqrt(2/(fan_in+fan_out))), bias ~ N(0, sqrt(1/fan_out))."""
     weights, biases = [], []
-    keys = jax.random.split(key, len(sizes) - 1)
-    for k, (din, dout) in zip(keys, zip(sizes[:-1], sizes[1:])):
-        # torch Linear default init: U(-1/sqrt(in), 1/sqrt(in))
-        bound = 1.0 / jnp.sqrt(jnp.float32(din))
-        weights.append(jax.random.uniform(k, (din, dout), dtype, -bound, bound))
-        biases.append(jnp.zeros((dout,), dtype))
+    keys = jax.random.split(key, 2 * (len(sizes) - 1))
+    for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+        w_std = (2.0 / (din + dout)) ** 0.5
+        b_std = (1.0 / dout) ** 0.5
+        weights.append(jax.random.normal(keys[2 * i], (din, dout), dtype) * w_std)
+        biases.append(jax.random.normal(keys[2 * i + 1], (dout,), dtype) * b_std)
     return weights, biases

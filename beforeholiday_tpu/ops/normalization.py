@@ -29,9 +29,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
+from beforeholiday_tpu.ops._pallas_util import (
+    interpret_default as _interpret_default,
+    pad_rows as _pad_rows_util,
+    resolve_impl as _resolve_impl,
+)
 
 
 def _row_block(hidden: int) -> int:
@@ -94,18 +96,10 @@ def _ln_bwd_kernel(rms, scal_ref, x_ref, w_ref, dy_ref, dx_ref, dw_ref, db_ref):
     db_ref[...] += jnp.sum(dy, axis=0, keepdims=True)
 
 
-def _pad_rows(x2d, br):
-    rows = x2d.shape[0]
-    padded = ((rows + br - 1) // br) * br
-    if padded != rows:
-        x2d = jnp.pad(x2d, ((0, padded - rows), (0, 0)))
-    return x2d, rows
-
-
 def _ln_fwd_pallas(x2d, w, b, eps, rms, out_dtype, interpret):
     hidden = x2d.shape[-1]
     br = _row_block(hidden)
-    xp, rows = _pad_rows(x2d, br)
+    xp, rows = _pad_rows_util(x2d, br)
     grid = xp.shape[0] // br
     scal = jnp.asarray([[eps]], jnp.float32)
     smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
@@ -125,8 +119,8 @@ def _ln_fwd_pallas(x2d, w, b, eps, rms, out_dtype, interpret):
 def _ln_bwd_pallas(x2d, w, dy2d, eps, rms, interpret):
     hidden = x2d.shape[-1]
     br = _row_block(hidden)
-    xp, rows = _pad_rows(x2d, br)
-    dyp, _ = _pad_rows(dy2d, br)
+    xp, rows = _pad_rows_util(x2d, br)
+    dyp, _ = _pad_rows_util(dy2d, br)
     grid = xp.shape[0] // br
     scal = jnp.asarray([[eps]], jnp.float32)
     smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
@@ -213,20 +207,6 @@ def _layer_norm_bwd(eps, rms, out_dtype, impl, res, dy):
 
 
 _layer_norm.defvjp(_layer_norm_fwd, _layer_norm_bwd)
-
-
-def _resolve_impl(impl: Optional[str]) -> str:
-    if impl is None:
-        # see ops/softmax.py _resolve_impl: pallas custom calls are opaque to
-        # the GSPMD partitioner, so multi-device defaults to the jnp path
-        impl = (
-            "pallas"
-            if jax.default_backend() == "tpu" and jax.device_count() == 1
-            else "jnp"
-        )
-    if impl not in ("pallas", "jnp"):
-        raise ValueError(f"impl must be 'pallas' or 'jnp', got {impl!r}")
-    return impl
 
 
 def fused_layer_norm(

@@ -27,27 +27,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from beforeholiday_tpu.ops._pallas_util import (
+    interpret_default as _interpret_default,
+    pad_rows as _pad_rows_util,
+    resolve_impl as _resolve_impl,
+)
+
 _MASK_VALUE = -10000.0  # ref: scaled_masked_softmax.h additive mask fill
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _resolve_impl(impl: Optional[str]) -> str:
-    if impl is None:
-        # pallas_call is an opaque custom call to the GSPMD partitioner: under
-        # a >1-device mesh it would force replication/all-gathers on sharded
-        # activations. Default to pallas only single-device; the jnp path
-        # partitions transparently. Explicit impl="pallas" is always honored.
-        impl = (
-            "pallas"
-            if jax.default_backend() == "tpu" and jax.device_count() == 1
-            else "jnp"
-        )
-    if impl not in ("pallas", "jnp"):
-        raise ValueError(f"impl must be 'pallas' or 'jnp', got {impl!r}")
-    return impl
 
 
 # ---------------------------------------------------------------------------------
@@ -81,17 +67,9 @@ def _softmax_bwd_kernel(scal_ref, y_ref, dy_ref, dx_ref):
     dx_ref[...] = dx.astype(dx_ref.dtype)
 
 
-def _pad_rows(x2d):
-    rows = x2d.shape[0]
-    padded = ((rows + _BR - 1) // _BR) * _BR
-    if padded != rows:
-        x2d = jnp.pad(x2d, ((0, padded - rows), (0, 0)))
-    return x2d, rows
-
-
 def _fwd_pallas(x2d, scale, causal, sq, out_dtype, interpret):
     sk = x2d.shape[-1]
-    xp, rows = _pad_rows(x2d)
+    xp, rows = _pad_rows_util(x2d, _BR)
     grid = xp.shape[0] // _BR
     smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
     row_spec = pl.BlockSpec((_BR, sk), lambda i: (i, 0), memory_space=pltpu.VMEM)
@@ -108,8 +86,8 @@ def _fwd_pallas(x2d, scale, causal, sq, out_dtype, interpret):
 
 def _bwd_pallas(y2d, dy2d, scale, interpret):
     sk = y2d.shape[-1]
-    yp, rows = _pad_rows(y2d)
-    dyp, _ = _pad_rows(dy2d)
+    yp, rows = _pad_rows_util(y2d, _BR)
+    dyp, _ = _pad_rows_util(dy2d, _BR)
     grid = yp.shape[0] // _BR
     smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
     row_spec = pl.BlockSpec((_BR, sk), lambda i: (i, 0), memory_space=pltpu.VMEM)

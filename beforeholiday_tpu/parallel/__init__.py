@@ -7,6 +7,18 @@ apex/transformer/parallel_state.py:81-682). NCCL process groups become named axe
 """
 
 from beforeholiday_tpu.parallel import parallel_state
+from beforeholiday_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    reduce_gradients,
+)
+from beforeholiday_tpu.parallel.larc import LARC
+from beforeholiday_tpu.parallel.sync_batch_norm import (
+    BatchNormParams,
+    BatchNormState,
+    init_batch_norm,
+    sync_batch_norm,
+)
 from beforeholiday_tpu.parallel.parallel_state import (
     initialize_model_parallel,
     destroy_model_parallel,
@@ -20,6 +32,14 @@ from beforeholiday_tpu.parallel.parallel_state import (
 
 __all__ = [
     "parallel_state",
+    "DistributedDataParallel",
+    "Reducer",
+    "reduce_gradients",
+    "LARC",
+    "BatchNormParams",
+    "BatchNormState",
+    "init_batch_norm",
+    "sync_batch_norm",
     "initialize_model_parallel",
     "destroy_model_parallel",
     "model_parallel_is_initialized",

@@ -1,0 +1,81 @@
+"""LARC — layer-wise adaptive rate control optimizer wrapper
+(ref: apex/parallel/LARC.py:5-107).
+
+The reference mutates ``p.grad`` inside a wrapped ``step``: per-parameter
+adaptive lr = trust_coefficient * ||p|| / (||g|| + wd*||p|| + eps), optionally
+clipped to the group lr, with weight decay folded into the gradient and zeroed
+in the inner optimizer for the step (:79-100). Functional equivalent: transform
+the grads, then delegate to any fused optimizer's ``step``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class LARC:
+    """Wrap a fused optimizer with LARC gradient conditioning.
+
+    ``weight_decay`` must live here, not in the inner optimizer (the reference
+    zeroes the group's wd during the wrapped step, :96-100) — construct the
+    inner optimizer with ``weight_decay=0``.
+    """
+
+    def __init__(
+        self,
+        inner,
+        *,
+        trust_coefficient: float = 0.02,
+        clip: bool = True,
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+    ):
+        inner_wd = getattr(inner, "weight_decay", 0.0)
+        if inner_wd:
+            raise ValueError(
+                "LARC applies weight decay itself; construct the inner optimizer "
+                "with weight_decay=0 (ref: apex/parallel/LARC.py:96-100)"
+            )
+        self.inner = inner
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        return self.inner.init(params)
+
+    def _condition(self, p, g, lr):
+        p32, g32 = p.astype(jnp.float32), g.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+        adaptive_lr = (
+            self.trust_coefficient
+            * p_norm
+            / (g_norm + self.weight_decay * p_norm + self.eps)
+        )
+        # norms==0 → keep lr unscaled (ref: LARC.py:83 'if param_norm != 0 and grad_norm != 0')
+        ok = (p_norm != 0.0) & (g_norm != 0.0)
+        if self.clip:
+            # clamp so the effective lr never exceeds the group lr (:90-92)
+            adaptive_lr = jnp.minimum(adaptive_lr / lr, 1.0)
+        adaptive_lr = jnp.where(ok, adaptive_lr, 1.0)
+        g_out = (g32 + self.weight_decay * p32) * adaptive_lr
+        return g_out.astype(g.dtype)
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        eff_lr = self.inner.lr if lr is None else lr
+        # unscale BEFORE conditioning: the reference conditions already-unscaled
+        # p.grad (LARC.py:75-100). Conditioning scaled grads would shrink the
+        # trust ratio by the loss scale and scale the folded wd term.
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * grad_scale, grads)
+        conditioned = jax.tree.map(
+            lambda p, g: self._condition(p, g, eff_lr), params, grads
+        )
+        return self.inner.step(
+            params, conditioned, state,
+            found_inf=found_inf, grad_scale=1.0, lr=lr,
+        )

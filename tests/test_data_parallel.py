@@ -1,0 +1,292 @@
+"""Data-parallel layer semantics on an 8-device CPU mesh.
+
+Ports of the reference's contracts: DP training is semantics-identical to
+single-device training on the concatenated batch (tests/distributed/DDP),
+SyncBN matches BatchNorm over the full batch
+(tests/distributed/synced_batchnorm/two_gpu_unit_test.py), LARC trust-ratio
+math (apex/parallel/LARC.py:79-94).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from jax.sharding import Mesh, PartitionSpec as P
+
+# DDP semantics require local (unreduced) grads — the check_vma=False mode of
+# jax.shard_map (see beforeholiday_tpu/parallel/distributed.py docstring)
+def shard_map(f=None, **kw):
+    kw.setdefault("check_vma", False)
+    if f is None:
+        return lambda g: jax.shard_map(g, **kw)
+    return jax.shard_map(f, **kw)
+
+from beforeholiday_tpu.optimizers import FusedSGD
+from beforeholiday_tpu.parallel import (
+    DistributedDataParallel,
+    LARC,
+    Reducer,
+    init_batch_norm,
+    reduce_gradients,
+    sync_batch_norm,
+)
+
+
+@pytest.fixture
+def data_mesh(devices8):
+    return Mesh(np.asarray(devices8).reshape(8), ("data",))
+
+
+def _loss_fn(params, x, y):
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+class TestReduceGradients:
+    def test_ddp_grads_match_global_batch(self, data_mesh):
+        """The key DDP oracle: per-shard grads + psum-average == full-batch grads."""
+        rng = np.random.RandomState(0)
+        params = {"w": jnp.asarray(rng.randn(8, 4), jnp.float32),
+                  "b": jnp.zeros((4,), jnp.float32)}
+        x = jnp.asarray(rng.randn(32, 8), jnp.float32)
+        y = jnp.asarray(rng.randn(32, 4), jnp.float32)
+
+        ddp = DistributedDataParallel()
+
+        @functools.partial(
+            shard_map, mesh=data_mesh,
+            in_specs=(P(), P("data"), P("data")), out_specs=(P(), P()),
+        )
+        def sharded_grads(params, x, y):
+            loss, grads = ddp.value_and_grad(_loss_fn)(params, x, y)
+            return jax.lax.pmean(loss, "data"), grads
+
+        loss_dp, grads_dp = jax.jit(sharded_grads)(params, x, y)
+        loss_ref, grads_ref = jax.value_and_grad(_loss_fn)(params, x, y)
+        np.testing.assert_allclose(float(loss_dp), float(loss_ref), rtol=1e-6)
+        for k in grads_ref:
+            np.testing.assert_allclose(
+                np.asarray(grads_dp[k]), np.asarray(grads_ref[k]), rtol=1e-5, atol=1e-6
+            )
+
+    def test_predivide_factor_equivalent(self, data_mesh):
+        """predivide: /f before, /(world/f) after == plain average (up to fp error)."""
+        grads = {"g": jnp.arange(16, dtype=jnp.float32).reshape(16)}
+
+        def run(**kw):
+            @functools.partial(
+                shard_map, mesh=data_mesh, in_specs=(P("data"),), out_specs=P("data")
+            )
+            def f(g):
+                return reduce_gradients({"g": g}, **kw)["g"]
+
+            return np.asarray(jax.jit(f)(grads["g"]))
+
+        plain = run()
+        pre = run(gradient_predivide_factor=4.0)
+        np.testing.assert_allclose(pre, plain, rtol=1e-6)
+
+    def test_no_average_sums(self, data_mesh):
+        @functools.partial(
+            shard_map, mesh=data_mesh, in_specs=(P("data"),), out_specs=P("data")
+        )
+        def f(g):
+            return reduce_gradients({"g": g}, gradient_average=False)["g"]
+
+        g = jnp.ones((8,), jnp.float32)
+        out = np.asarray(jax.jit(f)(g))
+        np.testing.assert_allclose(out, 8.0)
+
+    def test_fp32_allreduce_roundtrips_dtype(self, data_mesh):
+        @functools.partial(
+            shard_map, mesh=data_mesh, in_specs=(P("data"),), out_specs=P("data")
+        )
+        def f(g):
+            out = reduce_gradients({"g": g}, allreduce_always_fp32=True)["g"]
+            return out
+
+        g = jnp.ones((8,), jnp.bfloat16)
+        out = jax.jit(f)(g)
+        assert out.dtype == jnp.bfloat16
+
+    def test_ddp_training_identical_to_single_device(self, data_mesh):
+        """Several optimizer steps: DP on 8 shards == single device, bitwise-ish."""
+        rng = np.random.RandomState(1)
+        params = {"w": jnp.asarray(rng.randn(8, 4), jnp.float32),
+                  "b": jnp.zeros((4,), jnp.float32)}
+        opt = FusedSGD(lr=0.1, momentum=0.9, impl="jnp")
+        xs = jnp.asarray(rng.randn(5, 32, 8), jnp.float32)
+        ys = jnp.asarray(rng.randn(5, 32, 4), jnp.float32)
+
+        ddp = DistributedDataParallel()
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=data_mesh,
+            in_specs=(P(), P(), P("data"), P("data")),
+            out_specs=(P(), P()),
+        )
+        def dp_step(params, state, x, y):
+            _, grads = ddp.value_and_grad(_loss_fn)(params, x, y)
+            return opt.step(params, grads, state)
+
+        p_dp, s_dp = params, opt.init(params)
+        p_ref, s_ref = params, opt.init(params)
+        for i in range(5):
+            p_dp, s_dp = dp_step(p_dp, s_dp, xs[i], ys[i])
+            g_ref = jax.grad(_loss_fn)(p_ref, xs[i], ys[i])
+            p_ref, s_ref = opt.step(p_ref, g_ref, s_ref)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(p_dp[k]), np.asarray(p_ref[k]), rtol=1e-5, atol=1e-6
+            )
+
+    def test_reducer(self, data_mesh):
+        r = Reducer()
+
+        @functools.partial(
+            shard_map, mesh=data_mesh, in_specs=(P("data"),), out_specs=P("data")
+        )
+        def f(x):
+            return r.reduce({"x": x})["x"]
+
+        out = np.asarray(jax.jit(f)(jnp.arange(8, dtype=jnp.float32)))
+        np.testing.assert_allclose(out, np.full(8, np.arange(8).mean()))
+
+
+class TestSyncBatchNorm:
+    def test_matches_torch_bn_over_full_batch(self, data_mesh):
+        """SyncBN on 8 shards == torch BatchNorm2d on the concatenated batch."""
+        rng = np.random.RandomState(2)
+        x = rng.randn(16, 6, 4, 4).astype(np.float32)
+        params, state = init_batch_norm(6)
+
+        @functools.partial(
+            shard_map, mesh=data_mesh,
+            in_specs=(P("data"),), out_specs=(P("data"), P()),
+        )
+        def f(xs):
+            y, st = sync_batch_norm(xs, params, state, axis_name="data", training=True)
+            return y, st
+
+        y, new_state = jax.jit(f)(jnp.asarray(x))
+
+        bn = torch.nn.BatchNorm2d(6, eps=1e-5, momentum=0.1)
+        with torch.no_grad():
+            ty = bn(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(new_state.running_mean), bn.running_mean.numpy(), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_state.running_var), bn.running_var.numpy(), rtol=1e-4, atol=1e-4
+        )
+
+    def test_backward_matches_full_batch(self, data_mesh):
+        """Standard DDP pattern: local loss, grads summed across shards ==
+        grads of the same loss over the concatenated batch (the contract of
+        the reference's allreduce of (sum_dy, sum_dy_xmu) in SyncBatchnormFunction
+        backward)."""
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(16, 6, 3, 3), jnp.float32)
+        params, state = init_batch_norm(6)
+
+        def local_loss(params, xs):
+            y, _ = sync_batch_norm(xs, params, state, axis_name="data", training=True)
+            return jnp.sum(y**2)
+
+        @functools.partial(
+            shard_map, mesh=data_mesh, in_specs=(P(), P("data")), out_specs=P(),
+        )
+        def dp_grads(params, xs):
+            g = jax.grad(local_loss)(params, xs)
+            return reduce_gradients(g, gradient_average=False)
+
+        g_dp = jax.jit(dp_grads)(params, x)
+
+        def full_loss(params):
+            y, _ = sync_batch_norm(x, params, state, training=True)
+            return jnp.sum(y**2)
+
+        g_ref = jax.grad(full_loss)(params)
+        np.testing.assert_allclose(
+            np.asarray(g_dp.scale), np.asarray(g_ref.scale), rtol=1e-3, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(g_dp.bias), np.asarray(g_ref.bias), rtol=1e-3, atol=1e-3
+        )
+
+    def test_eval_mode_uses_running_stats(self):
+        params, state = init_batch_norm(4)
+        state = state._replace(
+            running_mean=jnp.full((4,), 2.0), running_var=jnp.full((4,), 4.0)
+        )
+        x = jnp.full((2, 4, 2), 6.0)
+        y, st = sync_batch_norm(x, params, state, training=False)
+        np.testing.assert_allclose(np.asarray(y), (6.0 - 2.0) / np.sqrt(4.0 + 1e-5), rtol=1e-5)
+        assert st is state
+
+    def test_channel_last_and_fuse_relu(self):
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(8, 4, 4, 6), jnp.float32)  # NHWC
+        params, state = init_batch_norm(6)
+        y, _ = sync_batch_norm(x, params, state, channel_last=True, fuse_relu=True)
+        x_nchw = jnp.transpose(x, (0, 3, 1, 2))
+        y2, _ = sync_batch_norm(x_nchw, params, state)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(jax.nn.relu(jnp.transpose(y2, (0, 2, 3, 1)))),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+class TestLARC:
+    def test_rejects_inner_weight_decay(self):
+        with pytest.raises(ValueError, match="weight decay"):
+            LARC(FusedSGD(lr=0.1, weight_decay=0.1, impl="jnp"))
+
+    def test_matches_manual_larc_math(self):
+        # single param: verify the adaptive lr against the reference formula
+        p = {"w": jnp.full((16,), 2.0)}
+        g = {"w": jnp.full((16,), 0.5)}
+        inner = FusedSGD(lr=0.1, impl="jnp")
+        larc = LARC(inner, trust_coefficient=0.02, clip=False, weight_decay=0.0)
+        state = larc.init(p)
+        p1, _ = larc.step(p, g, state)
+
+        p_norm = np.sqrt(16 * 4.0)
+        g_norm = np.sqrt(16 * 0.25)
+        adaptive = 0.02 * p_norm / (g_norm + 1e-8)
+        expected = 2.0 - 0.1 * adaptive * 0.5
+        np.testing.assert_allclose(np.asarray(p1["w"]), expected, rtol=1e-5)
+
+    def test_clip_caps_effective_lr(self):
+        # huge param norm → adaptive_lr >> lr; clip caps the multiplier at 1
+        p = {"w": jnp.full((16,), 100.0)}
+        g = {"w": jnp.full((16,), 1e-3)}
+        inner = FusedSGD(lr=0.1, impl="jnp")
+        larc = LARC(inner, trust_coefficient=0.02, clip=True)
+        p1, _ = larc.step(p, g, larc.init(p))
+        # clipped: step = lr * g exactly
+        np.testing.assert_allclose(np.asarray(p1["w"]), 100.0 - 0.1 * 1e-3, rtol=1e-6)
+
+    def test_zero_grad_keeps_unit_scale(self):
+        p = {"w": jnp.full((4,), 3.0)}
+        g = {"w": jnp.zeros((4,))}
+        larc = LARC(FusedSGD(lr=0.1, impl="jnp"), clip=False)
+        p1, _ = larc.step(p, g, larc.init(p))
+        np.testing.assert_allclose(np.asarray(p1["w"]), 3.0)
+
+    def test_trains_with_weight_decay(self):
+        p = {"w": jnp.full((32,), 2.0)}
+        larc = LARC(FusedSGD(lr=0.5, momentum=0.9, impl="jnp"),
+                    weight_decay=1e-3, clip=True)
+        state = larc.init(p)
+        step = jax.jit(lambda p, s: larc.step(p, {"w": p["w"]}, s))
+        hist = [4.0]
+        for _ in range(20):
+            p, state = step(p, state)
+            hist.append(float(jnp.mean(p["w"] ** 2)))
+        assert hist[-1] < hist[0]
