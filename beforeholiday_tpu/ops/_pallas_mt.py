@@ -28,6 +28,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .arena import LANES
+from ._pallas_util import interpret_default as _interpret_default
 
 # One grid step processes BLOCK_ROWS x 128 lanes = 32768 elements per operand
 # (128 KiB fp32) — the same role as the reference's chunk_size 2048*32
@@ -35,12 +36,6 @@ from .arena import LANES
 # of BLOCK_ELEMS by arena.flatten.
 BLOCK_ROWS = 256
 BLOCK_ELEMS = BLOCK_ROWS * LANES
-
-
-def _interpret_default() -> bool:
-    # Pallas compiles natively on TPU; everywhere else (CPU test mesh) the
-    # interpreter executes the same kernel semantics.
-    return jax.default_backend() != "tpu"
 
 
 def ew_call(

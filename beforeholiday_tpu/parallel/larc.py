@@ -62,8 +62,10 @@ class LARC:
         if self.clip:
             # clamp so the effective lr never exceeds the group lr (:90-92)
             adaptive_lr = jnp.minimum(adaptive_lr / lr, 1.0)
-        adaptive_lr = jnp.where(ok, adaptive_lr, 1.0)
-        g_out = (g32 + self.weight_decay * p32) * adaptive_lr
+        # wd fold and trust scaling only apply inside the ok branch — the
+        # reference leaves a zero gradient untouched (LARC.py:83-94), so a
+        # frozen param must not decay
+        g_out = jnp.where(ok, (g32 + self.weight_decay * p32) * adaptive_lr, g32)
         return g_out.astype(g.dtype)
 
     def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):

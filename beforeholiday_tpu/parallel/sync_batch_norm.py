@@ -69,18 +69,21 @@ def sync_batch_norm(
     xf = x.astype(jnp.float32)
 
     if training:
+        # two-pass statistics: global mean first, then centered second moment —
+        # stable like the reference's Welford path, where a raw E[x^2]-mean^2
+        # merge would cancel catastrophically for large-mean channels
         count = jnp.float32(math.prod(x.shape[i] for i in reduce_axes))
-        mean = jnp.mean(xf, axis=reduce_axes)
-        var = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean)
+        local_sum = jnp.sum(xf, axis=reduce_axes)
         if axis_name is not None:
-            # Welford parallel merge over the device axis (equal local counts):
-            # psum the raw moments, derive global mean/var
-            total = jax.lax.psum(count, axis_name)
-            s1 = jax.lax.psum(count * mean, axis_name)
-            s2 = jax.lax.psum(count * (var + jnp.square(mean)), axis_name)
-            mean = s1 / total
-            var = s2 / total - jnp.square(mean)
-            count = total
+            count = jax.lax.psum(count, axis_name)
+            mean = jax.lax.psum(local_sum, axis_name) / count
+            centered_sq = jnp.sum(
+                jnp.square(xf - mean.reshape(shape_bc)), axis=reduce_axes
+            )
+            var = jax.lax.psum(centered_sq, axis_name) / count
+        else:
+            mean = local_sum / count
+            var = jnp.mean(jnp.square(xf - mean.reshape(shape_bc)), axis=reduce_axes)
         # running stats use unbiased variance (torch semantics)
         unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
         new_state = BatchNormState(

@@ -1,0 +1,8 @@
+"""Megatron-style model parallelism on the mesh (ref: apex/transformer/).
+
+``tensor_parallel`` — TP/SP mappings, layers, vocab-parallel CE, per-shard RNG,
+activation checkpointing. ``pipeline_parallel`` — schedules and stage
+communication. ``parallel_state`` lives in ``beforeholiday_tpu.parallel``.
+"""
+
+from beforeholiday_tpu.transformer import tensor_parallel  # noqa: F401

@@ -1,0 +1,94 @@
+"""Vocab-parallel cross entropy (ref: apex/transformer/tensor_parallel/cross_entropy.py:23-103).
+
+The reference's ``_VocabParallelCrossEntropy``: local max → allreduce MAX →
+local sum-exp → allreduce SUM → masked target-logit allreduce, with the
+backward ``softmax - onehot`` computed from saved residuals. Implemented as a
+custom VJP over ``pmax``/``psum`` so the collective transposes are pinned
+(see mappings.py rationale), with the reference's optional label smoothing
+(:80-89).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
+from beforeholiday_tpu.transformer.tensor_parallel.layers import vocab_range
+
+
+def _fwd_math(logits, target, vocab_size, axis_name):
+    """Returns (loss, (softmax_local, target_mask_local, local_idx))."""
+    x = logits.astype(jnp.float32)
+    # 1. global max for stability (allreduce MAX, ref :31-36)
+    xmax = jax.lax.pmax(jnp.max(x, axis=-1), axis_name)
+    x = x - xmax[..., None]
+    # 2. global sum of exp (allreduce SUM, ref :56-62)
+    ex = jnp.exp(x)
+    sum_ex = jax.lax.psum(jnp.sum(ex, axis=-1), axis_name)
+    # 3. target logit: only the owning rank contributes (ref :38-54)
+    start, local = vocab_range(vocab_size, axis_name)
+    in_range = (target >= start) & (target < start + local)
+    local_idx = jnp.where(in_range, target - start, 0)
+    tgt = jnp.take_along_axis(x, local_idx[..., None], axis=-1)[..., 0]
+    tgt = jnp.where(in_range, tgt, 0.0)
+    tgt = jax.lax.psum(tgt, axis_name)
+    loss = jnp.log(sum_ex) - tgt
+    softmax_local = ex / sum_ex[..., None]
+    return loss, (softmax_local, in_range, local_idx)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def vocab_parallel_cross_entropy(
+    logits: jax.Array,  # (..., vocab/world) local shard
+    target: jax.Array,  # (...,) int global vocab ids
+    vocab_size: int,
+    label_smoothing: float = 0.0,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    """Per-token CE loss over vocab-sharded logits. Returns (...,) fp32."""
+    loss, (softmax_local, in_range, _) = _fwd_math(
+        logits, target, vocab_size, axis_name
+    )
+    if label_smoothing > 0:
+        # ref :80-89: smoothed loss mixes the mean log-prob over the vocab
+        log_probs = jnp.log(jnp.maximum(softmax_local, 1e-30))
+        mean_log = jax.lax.psum(jnp.sum(log_probs, axis=-1), axis_name) / vocab_size
+        loss = (1.0 - label_smoothing) * loss - label_smoothing * mean_log
+    return loss
+
+
+def _ce_fwd(logits, target, vocab_size, label_smoothing, axis_name):
+    loss, (softmax_local, in_range, local_idx) = _fwd_math(
+        logits, target, vocab_size, axis_name
+    )
+    if label_smoothing > 0:
+        log_probs = jnp.log(jnp.maximum(softmax_local, 1e-30))
+        mean_log = jax.lax.psum(jnp.sum(log_probs, axis=-1), axis_name) / vocab_size
+        loss = (1.0 - label_smoothing) * loss - label_smoothing * mean_log
+    # zero-size sentinel carries the primal dtype through the residuals
+    return loss, (softmax_local, in_range, local_idx, jnp.zeros((0,), logits.dtype))
+
+
+def _ce_bwd(vocab_size, label_smoothing, axis_name, res, dy):
+    """grad = softmax - onehot (ref :91-103), smoothed variant included."""
+    softmax_local, in_range, local_idx, dtype_sentinel = res
+    dtype = dtype_sentinel.dtype
+    onehot = jnp.zeros_like(softmax_local)
+    upd = in_range.astype(jnp.float32)
+    onehot = jnp.put_along_axis(
+        onehot, local_idx[..., None], upd[..., None], axis=-1, inplace=False
+    )
+    if label_smoothing > 0:
+        # d/dx [(1-s)*nll - s*mean_log] = (1-s)*(p - onehot) + s*(p - 1/V)
+        grad = (1.0 - label_smoothing) * (softmax_local - onehot) + label_smoothing * (
+            softmax_local - 1.0 / vocab_size
+        )
+    else:
+        grad = softmax_local - onehot
+    return (grad * dy[..., None]).astype(dtype), None
+
+
+vocab_parallel_cross_entropy.defvjp(_ce_fwd, _ce_bwd)

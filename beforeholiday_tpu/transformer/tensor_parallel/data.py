@@ -1,0 +1,47 @@
+"""TP data broadcast (ref: apex/transformer/tensor_parallel/data.py:25-122).
+
+The reference broadcasts the batch dict from TP rank 0 over NCCL so every
+tensor-parallel peer sees identical data. Under single-controller SPMD the
+host feeds every device from the same arrays, so consistency holds by
+construction; ``broadcast_data`` validates the contract and (inside shard_map)
+can force agreement by selecting rank 0's values.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
+
+
+def broadcast_data(
+    keys: Sequence[str],
+    data: Dict[str, jax.Array],
+    datatype=None,
+    *,
+    axis_name: str = TENSOR_AXIS,
+    force: bool = False,
+) -> Dict[str, jax.Array]:
+    """Return the batch as seen by TP rank 0.
+
+    ``force=False`` (default): identity with key/dtype validation — the SPMD
+    analogue of the reference's fast path, since one controller materializes
+    one batch. ``force=True`` (inside shard_map): physically select rank 0's
+    values via a masked psum, reproducing the NCCL broadcast even if a caller
+    fed rank-varying data (ref: data.py:84-117).
+    """
+    out = {}
+    for k in keys:
+        if k not in data:
+            raise KeyError(f"broadcast_data: missing key {k!r}")
+        v = data[k]
+        if datatype is not None and v.dtype != jnp.dtype(datatype):
+            raise TypeError(f"broadcast_data: {k} has dtype {v.dtype}, expected {datatype}")
+        if force:
+            is_src = (jax.lax.axis_index(axis_name) == 0).astype(v.dtype)
+            v = jax.lax.psum(v * is_src, axis_name)
+        out[k] = v
+    return out

@@ -1,0 +1,113 @@
+"""TP layers (ref: apex/transformer/tensor_parallel/layers.py:167-780).
+
+Functional ports of ``VocabParallelEmbedding`` (:167), ``ColumnParallelLinear``
+(:429), ``RowParallelLinear`` (:613). Each takes this rank's weight shard and
+runs inside ``shard_map`` with the tensor axis bound. The reference's async
+allreduce / wgrad-fusion machinery (:272-384) is XLA's latency-hiding
+scheduler's job: the custom-VJP collectives in ``mappings.py`` appear in the
+backward HLO where the scheduler overlaps them with the surrounding GEMMs.
+
+Weight layout convention is (in, out) — column-parallel shards ``out``,
+row-parallel shards ``in`` — matching the mesh PartitionSpecs used across the
+framework (e.g. testing/gpt.py ``param_specs``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
+from beforeholiday_tpu.transformer.tensor_parallel import mappings as mp
+
+
+def column_parallel_linear(
+    x: jax.Array,
+    weight: jax.Array,  # (in, out/world) local shard
+    bias: Optional[jax.Array] = None,  # (out/world,) local shard
+    *,
+    gather_output: bool = False,
+    sequence_parallel: bool = False,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    """Y = X @ A with A column-sharded (ref: layers.py:429 ``ColumnParallelLinear``).
+
+    ``sequence_parallel``: x arrives sequence-sharded (dim 0); the activations
+    are all-gathered before the GEMM and the backward reduce-scatters —
+    the fusion at layers.py:293-306,355-363. Otherwise x is replicated and the
+    f-conjugate (id fwd / psum bwd) applies.
+    """
+    if sequence_parallel:
+        x = mp.gather_from_sequence_parallel_region(
+            x, axis_name, True  # bwd reduce-scatters the dgrad
+        )
+    else:
+        x = mp.copy_to_tensor_model_parallel_region(x, axis_name)
+    y = x @ weight.astype(x.dtype)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    if gather_output:
+        assert not sequence_parallel, "cannot gather output in sequence-parallel mode"
+        y = mp.gather_from_tensor_model_parallel_region(y, axis_name)
+    return y
+
+
+def row_parallel_linear(
+    x: jax.Array,
+    weight: jax.Array,  # (in/world, out) local shard
+    bias: Optional[jax.Array] = None,  # (out,) replicated
+    *,
+    input_is_parallel: bool = True,
+    sequence_parallel: bool = False,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    """Y = X @ A with A row-sharded (ref: layers.py:613 ``RowParallelLinear``).
+
+    The partial products are allreduced (g-conjugate), or reduce-scattered onto
+    the sequence dim when ``sequence_parallel`` (layers.py:744-771). The bias is
+    added *after* the reduction, on full values, exactly as the reference.
+    """
+    if not input_is_parallel:
+        assert not sequence_parallel
+        x = mp.scatter_to_tensor_model_parallel_region(x, axis_name)
+    y_partial = x @ weight.astype(x.dtype)
+    if sequence_parallel:
+        y = mp.reduce_scatter_to_sequence_parallel_region(y_partial, axis_name)
+    else:
+        y = mp.reduce_from_tensor_model_parallel_region(y_partial, axis_name)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+def vocab_range(vocab_size: int, axis_name: str = TENSOR_AXIS) -> Tuple[jax.Array, int]:
+    """(this rank's first vocab index, local vocab size) —
+    ref: VocabUtility.vocab_range_from_global_vocab_size (layers.py:103-115)."""
+    world = jax.lax.axis_size(axis_name)
+    assert vocab_size % world == 0, f"vocab {vocab_size} not divisible by {world}"
+    local = vocab_size // world
+    return jax.lax.axis_index(axis_name) * local, local
+
+
+def vocab_parallel_embedding(
+    tokens: jax.Array,  # (...,) int
+    weight: jax.Array,  # (vocab/world, hidden) local shard
+    *,
+    vocab_size: int,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    """Vocab-sharded embedding lookup (ref: layers.py:167 ``VocabParallelEmbedding``).
+
+    Tokens outside this rank's range contribute zero rows; one psum assembles
+    the full embedding (:237-252 forward masking + allreduce). The backward —
+    scatter-add into the local shard for locally-owned tokens — falls out of
+    autodiff through the mask; the psum is pinned id-bwd via the g-conjugate.
+    """
+    start, local = vocab_range(vocab_size, axis_name)
+    in_range = (tokens >= start) & (tokens < start + local)
+    local_idx = jnp.where(in_range, tokens - start, 0)
+    out = weight[local_idx]
+    out = jnp.where(in_range[..., None], out, 0.0)
+    return mp.reduce_from_tensor_model_parallel_region(out, axis_name)

@@ -1,0 +1,181 @@
+"""TP/SP collective mappings (ref: apex/transformer/tensor_parallel/mappings.py).
+
+Megatron's conjugate autograd pairs, expressed as ``jax.custom_vjp`` functions
+over explicit ``jax.lax`` collectives, to be used inside ``shard_map`` with a
+bound tensor axis:
+
+    f: copy_to_tensor_model_parallel_region     — id fwd  / psum bwd   (:23-45)
+    g: reduce_from_tensor_model_parallel_region — psum fwd / id bwd    (:48-68)
+    scatter/gather last-dim pairs                                       (:71-135)
+    sequence-parallel first-dim scatter/gather/reduce-scatter           (:205-260)
+
+Custom VJPs are load-bearing: inside ``check_vma=False`` shard_map, jax's
+default ``psum`` transpose is ``psum`` (pmap legacy), which double-counts for
+replicated cotangents. Pinning each mapping's backward to the Megatron
+conjugate makes the semantics deterministic in either vma mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
+
+
+def _split_along(x, dim, axis_name):
+    """This rank's shard of x along dim (ref: mappings.py _split last-dim split)."""
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    size = x.shape[dim]
+    assert size % world == 0, f"dim {dim} size {size} not divisible by {world}"
+    shard = size // world
+    return jax.lax.dynamic_slice_in_dim(x, rank * shard, shard, axis=dim)
+
+
+def _all_gather(x, dim, axis_name):
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _reduce_scatter(x, dim, axis_name):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+# --- f / g conjugates --------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Identity forward, allreduce backward (ref: mappings.py:23-45 ``_CopyToModelParallelRegion``)."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, dy):
+    return (jax.lax.psum(dy, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Allreduce forward, identity backward (ref: mappings.py:48-68 ``_ReduceFromModelParallelRegion``)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, dy):
+    return (dy,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# --- last-dim scatter/gather (TP activations) --------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Split last dim fwd, all-gather bwd (ref: mappings.py:71-99)."""
+    return _split_along(x, -1, axis_name)
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_along(x, -1, axis_name), None
+
+
+def _scatter_bwd(axis_name, _, dy):
+    return (_all_gather(dy, -1, axis_name),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """All-gather last dim fwd, split bwd (ref: mappings.py:102-135)."""
+    return _all_gather(x, -1, axis_name)
+
+
+def _gather_fwd(x, axis_name):
+    return _all_gather(x, -1, axis_name), None
+
+
+def _gather_bwd(axis_name, _, dy):
+    return (_split_along(dy, -1, axis_name),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# --- sequence-parallel first-dim mappings (ref: mappings.py:205-260) ----------------
+#
+# Megatron SP shards the *sequence* dim of activations over the same ranks as
+# TP. Convention here: the sequence dim is dim 0 (s, b, h), exactly as the
+# reference's ``_GatherFromSequenceParallelRegion`` et al. operate on dim 0.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Split dim 0 fwd, all-gather bwd (ref: ``_ScatterToSequenceParallelRegion``)."""
+    return _split_along(x, 0, axis_name)
+
+
+def _scatter_sp_fwd(x, axis_name):
+    return _split_along(x, 0, axis_name), None
+
+
+def _scatter_sp_bwd(axis_name, _, dy):
+    return (_all_gather(dy, 0, axis_name),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_scatter_sp_fwd, _scatter_sp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(
+    x, axis_name=TENSOR_AXIS, tensor_parallel_output_grad=True
+):
+    """All-gather dim 0 fwd; bwd reduce-scatters when the consumer is a TP op
+    (each rank contributes a partial grad for every token), else plain split
+    (ref: ``_GatherFromSequenceParallelRegion``, tensor_parallel_output_grad)."""
+    return _all_gather(x, 0, axis_name)
+
+
+def _gather_sp_fwd(x, axis_name, tp_grad):
+    return _all_gather(x, 0, axis_name), None
+
+
+def _gather_sp_bwd(axis_name, tp_grad, _, dy):
+    if tp_grad:
+        return (_reduce_scatter(dy, 0, axis_name),)
+    return (_split_along(dy, 0, axis_name),)
+
+
+gather_from_sequence_parallel_region.defvjp(_gather_sp_fwd, _gather_sp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Reduce-scatter dim 0 fwd, all-gather bwd (ref: ``_ReduceScatterToSequenceParallelRegion``)."""
+    return _reduce_scatter(x, 0, axis_name)
+
+
+def _rs_sp_fwd(x, axis_name):
+    return _reduce_scatter(x, 0, axis_name), None
+
+
+def _rs_sp_bwd(axis_name, _, dy):
+    return (_all_gather(dy, 0, axis_name),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_rs_sp_fwd, _rs_sp_bwd)

@@ -1,0 +1,52 @@
+"""MemoryBuffer (ref: apex/transformer/tensor_parallel/memory.py:25-146).
+
+The reference preallocates one flat CUDA tensor and hands out zero-copy views
+to avoid allocator churn for activation-sized temporaries. XLA owns TPU memory
+— buffers are placed/reused by the compiler, and donation (``jax.jit(...,
+donate_argnums=...)``) covers in-place reuse — so this port keeps the API as a
+*view allocator over a flat arena* for code structured around it, while the
+docstring is explicit that it is not a performance lever on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MemoryBuffer:
+    """Flat preallocated buffer handing out reshaped views (ref: memory.py:25-77)."""
+
+    def __init__(self, numel: int, dtype=jnp.float32):
+        self.numel = numel
+        self.dtype = jnp.dtype(dtype)
+        self.data = jnp.zeros((numel,), dtype)
+
+    def zero(self) -> None:
+        self.data = jnp.zeros_like(self.data)
+
+    def get(self, shape: Tuple[int, ...], start_index: int) -> jax.Array:
+        """View of the buffer at [start, start+prod(shape)) reshaped to shape."""
+        n = math.prod(shape)
+        if start_index + n > self.numel:
+            raise ValueError(
+                f"requested {n} elements at offset {start_index} exceeds buffer "
+                f"size {self.numel}"
+            )
+        return jax.lax.dynamic_slice_in_dim(self.data, start_index, n).reshape(shape)
+
+
+class RingMemBuffer:
+    """Ring of MemoryBuffers (ref: memory.py:80-146 ``RingMemBuffer``)."""
+
+    def __init__(self, num_buffers: int, numel: int, dtype=jnp.float32):
+        self.num_buffers = num_buffers
+        self.buffers = [MemoryBuffer(numel, dtype) for _ in range(num_buffers)]
+        self._index = -1
+
+    def get_next_buffer(self) -> MemoryBuffer:
+        self._index = (self._index + 1) % self.num_buffers
+        return self.buffers[self._index]

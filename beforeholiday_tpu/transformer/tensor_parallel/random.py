@@ -1,0 +1,70 @@
+"""Per-shard RNG + activation checkpointing
+(ref: apex/transformer/tensor_parallel/random.py:48-311).
+
+The reference maintains a ``CudaRNGStatesTracker`` that forks/restores CUDA RNG
+states so TP ranks draw *different* dropout masks from seed+2718+tp_rank while
+staying reproducible across recompute (:124-199, :204-234). JAX PRNG keys are
+values, so the entire state machine collapses to ``jax.random.fold_in``:
+
+* ``model_parallel_seed(key)``    — per-TP-rank key (the tracker's
+  ``model-parallel-rng`` state, seed offset 2718)
+* ``data_parallel_seed(key)``     — per-DP-rank key
+* activation recompute reuses the *same* key by construction — replayed traces
+  see identical fold_in inputs, which is the property ``CheckpointFunction``'s
+  RNG save/restore machinery (:237-311) exists to enforce.
+
+``checkpoint`` wraps ``jax.checkpoint``: XLA rematerializes the region in the
+backward, the TPU equivalent of recompute-in-backward, and sharded residuals
+(``distribute_saved_activations``) are GSPMD's default under sharding
+constraints rather than a manual scatter.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+
+from beforeholiday_tpu.parallel.parallel_state import (
+    DATA_AXIS,
+    TENSOR_AXIS,
+)
+
+# the reference's magic offset: tensor-parallel seed = seed + 2718 + tp_rank
+# (ref: random.py:204-234 model_parallel_cuda_manual_seed)
+_MODEL_PARALLEL_OFFSET = 2718
+
+
+def model_parallel_seed(key: jax.Array, axis_name: str = TENSOR_AXIS) -> jax.Array:
+    """Per-TP-rank PRNG key (distinct dropout masks per shard). Inside shard_map."""
+    rank = jax.lax.axis_index(axis_name)
+    return jax.random.fold_in(key, _MODEL_PARALLEL_OFFSET + rank)
+
+
+def data_parallel_seed(key: jax.Array, axis_name: str = DATA_AXIS) -> jax.Array:
+    """Per-DP-rank key (e.g. independent data augmentation per replica)."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+def checkpoint(
+    fn: Callable,
+    *,
+    policy: Optional[Callable] = None,
+    prevent_cse: bool = True,
+    distribute_saved_activations: bool = False,
+) -> Callable:
+    """Activation recompute (ref: random.py:237-311 ``CheckpointFunction``/``checkpoint``).
+
+    Returns fn wrapped so its internals are rematerialized in the backward.
+    ``distribute_saved_activations`` is accepted for API parity: under GSPMD the
+    saved residuals inherit the activations' shardings, which is precisely the
+    reference's scatter-to-TP-ranks optimization done by the partitioner.
+    """
+    del distribute_saved_activations
+    return jax.checkpoint(fn, policy=policy, prevent_cse=prevent_cse)
+
+
+# convenience: the reference exposes `checkpoint(function, *args)` call-style
+def checkpoint_apply(fn: Callable, *args, **kw):
+    return checkpoint(fn)(*args, **kw)
