@@ -5,4 +5,5 @@ activation checkpointing. ``pipeline_parallel`` — schedules and stage
 communication. ``parallel_state`` lives in ``beforeholiday_tpu.parallel``.
 """
 
+from beforeholiday_tpu.transformer import pipeline_parallel  # noqa: F401
 from beforeholiday_tpu.transformer import tensor_parallel  # noqa: F401

@@ -1,0 +1,14 @@
+"""Pipeline parallelism (ref: apex/transformer/pipeline_parallel/)."""
+
+from beforeholiday_tpu.transformer.pipeline_parallel.microbatches import (  # noqa: F401
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+from beforeholiday_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401
+from beforeholiday_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)

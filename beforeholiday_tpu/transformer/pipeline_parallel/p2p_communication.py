@@ -1,0 +1,59 @@
+"""Pipeline stage communication (ref: apex/transformer/pipeline_parallel/p2p_communication.py:48-578).
+
+The reference batches NCCL isend/irecv pairs between pipeline ranks. On TPU the
+stage ring lives on a mesh axis and every p2p pattern is one
+``lax.ppermute`` — a physical ICI neighbor copy when stages are laid out
+contiguously on the ``pipe`` axis (which ``initialize_model_parallel``
+guarantees). All functions run inside shard_map with the pipe axis bound.
+
+Ring semantics replace the reference's FutureTensor async handles: XLA
+schedules the collective-permute asynchronously against surrounding compute,
+which is the overlap ``_communicate``'s side streams buy on CUDA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from beforeholiday_tpu.parallel.parallel_state import PIPE_AXIS
+
+
+def _ring(axis_name: str, shift: int):
+    n = jax.lax.axis_size(axis_name)
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def send_forward_recv_forward(x, *, axis_name: str = PIPE_AXIS):
+    """Every stage sends its activation to the next stage and receives the
+    previous stage's (ref: send_forward + recv_forward fused, :048-110). The
+    first stage receives stage N-1's value — callers mask it."""
+    return jax.lax.ppermute(x, axis_name, _ring(axis_name, +1))
+
+
+def send_backward_recv_backward(dy, *, axis_name: str = PIPE_AXIS):
+    """Gradient ring in the reverse direction (ref: send_backward_recv_backward)."""
+    return jax.lax.ppermute(dy, axis_name, _ring(axis_name, -1))
+
+
+# aliases matching the reference's public names; under a collective ring the
+# send/recv halves are one op, so each alias maps to the fused permute
+send_forward = send_forward_recv_forward
+recv_forward = send_forward_recv_forward
+send_backward = send_backward_recv_backward
+recv_backward = send_backward_recv_backward
+
+
+def send_forward_recv_backward(y, dy, *, axis_name: str = PIPE_AXIS):
+    """Steady-state 1F1B pair (ref: :send_forward_recv_backward): activation
+    ring forward, gradient ring backward, one tick."""
+    return (
+        jax.lax.ppermute(y, axis_name, _ring(axis_name, +1)),
+        jax.lax.ppermute(dy, axis_name, _ring(axis_name, -1)),
+    )
+
+
+def send_backward_recv_forward(dy, y, *, axis_name: str = PIPE_AXIS):
+    out_y, out_dy = send_forward_recv_backward(y, dy, axis_name=axis_name)
+    return out_dy, out_y

@@ -1,0 +1,188 @@
+"""Pipeline-parallel forward/backward schedules
+(ref: apex/transformer/pipeline_parallel/schedules/).
+
+The reference drives per-rank processes through warmup/steady-1F1B/cooldown
+with explicit NCCL p2p (fwd_bwd_pipelining_without_interleaving.py:228-488).
+TPU-native design: the whole schedule is ONE jitted collective program inside
+``shard_map`` over the ``pipe`` axis — a tick loop (``lax.fori_loop``) where at
+tick ``t``:
+
+    stage s runs F(m) iff  t == m + s
+    stage s runs B(m) iff  t == m + (2S - 1 - s)
+
+which is exactly the 1F1B diamond: the last stage's B(0) fires one tick after
+its F(0), every device alternates F/B in the steady state, and total ticks are
+``M + 2S - 1`` — the 1F1B bubble. Activations ride a +1 ``ppermute`` ring,
+gradients a −1 ring, and idle slots compute on masked garbage that never
+lands (the TPU version of pipeline bubbles — same wasted cycles, no branches).
+
+Backward recomputes the stage forward from the saved stage *input* under
+``jax.vjp`` — activation recompute exactly as Megatron runs under
+activation checkpointing; residual memory per stage is the saved inputs.
+
+Losses follow the reference's convention: each microbatch loss is divided by
+``num_microbatches`` (schedules/common.py:253 ``forward_step``), so grads
+accumulate to the mean-loss gradient.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.parallel.parallel_state import PIPE_AXIS
+from beforeholiday_tpu.transformer.pipeline_parallel import p2p_communication
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int],
+    pipeline_model_parallel_size: int,
+):
+    """Schedule dispatcher (ref: schedules/__init__.py:22-35)."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining
+
+
+def forward_backward_no_pipelining(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params: Any,
+    inputs: jax.Array,
+    targets: jax.Array,
+    **_,
+):
+    """Grad-accumulation loop without stage parallelism
+    (ref: schedules/fwd_bwd_no_pipelining.py). inputs/targets lead with the
+    microbatch dim (M, ...). Returns (mean loss, param grads)."""
+    M = inputs.shape[0]
+
+    def mb_loss(params, x, tgt):
+        return loss_fn(stage_fn(params, x), tgt) / M
+
+    def body(carry, xs):
+        loss_acc, gacc = carry
+        x, tgt = xs
+        loss, g = jax.value_and_grad(mb_loss)(params, x, tgt)
+        return (loss_acc + loss, jax.tree.map(jnp.add, gacc, g)), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (loss, grads), _ = jax.lax.scan(body, (jnp.float32(0.0), zeros), (inputs, targets))
+    return loss, grads
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params: Any,
+    inputs: jax.Array,
+    targets: jax.Array,
+    *,
+    axis_name: str = PIPE_AXIS,
+):
+    """1F1B schedule (ref: fwd_bwd_pipelining_without_interleaving.py:228-488).
+
+    Runs INSIDE shard_map with the pipe axis bound. ``params`` is this stage's
+    slice; ``inputs`` (M, *micro) feeds stage 0; ``targets`` (M, *tgt) are
+    consumed by the last stage. Activations between stages must all share
+    ``inputs``'s per-microbatch shape/dtype (the reference's fixed
+    ``tensor_shape`` contract, :241). Returns (mean loss, this stage's grads);
+    loss is valid on every stage (psum'd), as the reference broadcasts it.
+    """
+    S = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = inputs.shape[0]
+    micro_shape = inputs.shape[1:]
+    # last backward: B(M-1) on stage 0 at t = (M-1) + (2S-1) → inclusive range
+    total_ticks = M + 2 * S - 1
+
+    is_first = rank == 0
+    is_last = rank == S - 1
+
+    def fwd_only(p, x):
+        return stage_fn(p, x)
+
+    def last_stage_loss(p, x, tgt):
+        return loss_fn(stage_fn(p, x), tgt) / M
+
+    zeros_g = jax.tree.map(jnp.zeros_like, params)
+
+    def tick(t, carry):
+        act_store, fwd_reg, bwd_reg, gacc, loss_acc = carry
+
+        # ---- forward slot: F(m) at t == m + rank --------------------------------
+        m_f = t - rank
+        f_valid = (m_f >= 0) & (m_f < M)
+        m_f_c = jnp.clip(m_f, 0, M - 1)
+        x_in = jnp.where(is_first, inputs[m_f_c], fwd_reg)
+        y = stage_fn(params, x_in)
+        # stash the stage input for the backward recompute
+        act_store = jnp.where(
+            f_valid,
+            jax.lax.dynamic_update_index_in_dim(act_store, x_in, m_f_c, 0),
+            act_store,
+        )
+        # last stage: bank the microbatch loss at forward time from the already
+        # computed y (ref: the loss reduction in forward_step)
+        mb_loss = loss_fn(y, targets[m_f_c]) / M
+        loss_acc = loss_acc + jnp.where(f_valid & is_last, mb_loss, 0.0)
+
+        # ---- backward slot: B(m) at t == m + (2S - 1 - rank) --------------------
+        m_b = t - (2 * S - 1 - rank)
+        b_valid = (m_b >= 0) & (m_b < M)
+        m_b_c = jnp.clip(m_b, 0, M - 1)
+        x_saved = jax.lax.dynamic_index_in_dim(act_store, m_b_c, 0, keepdims=False)
+
+        # recompute-vjp of this stage for microbatch m_b
+        def stage_and_dx(dy):
+            _, vjp = jax.vjp(fwd_only, params, x_saved)
+            return vjp(dy)
+
+        def last_stage_grads():
+            return jax.grad(last_stage_loss, argnums=(0, 1))(
+                params, x_saved, targets[m_b_c]
+            )
+
+        def inner_grads():
+            return stage_and_dx(bwd_reg)
+
+        dp, dx = jax.lax.cond(is_last, last_stage_grads, inner_grads)
+
+        gacc = jax.tree.map(
+            lambda a, d: a + jnp.where(b_valid, d, 0.0).astype(a.dtype), gacc, dp
+        )
+
+        # ---- rings: the steady-state 1F1B send/recv pair ------------------------
+        fwd_reg, bwd_reg = p2p_communication.send_forward_recv_backward(
+            y, jnp.where(b_valid, dx, 0.0), axis_name=axis_name
+        )
+        return act_store, fwd_reg, bwd_reg, gacc, loss_acc
+
+    act_store0 = jnp.zeros((M,) + micro_shape, inputs.dtype)
+    fwd_reg0 = jnp.zeros(micro_shape, inputs.dtype)
+    bwd_reg0 = jnp.zeros(micro_shape, inputs.dtype)
+    act_store, _, _, grads, loss = jax.lax.fori_loop(
+        0,
+        total_ticks,
+        tick,
+        (act_store0, fwd_reg0, bwd_reg0, zeros_g, jnp.float32(0.0)),
+    )
+    # every stage reports the mean loss (ref: losses_reduced broadcast)
+    loss = jax.lax.psum(loss, axis_name)
+    return loss, grads
+
+
+def forward_backward_pipelining_with_interleaving(*args, **kw):
+    """Interleaved virtual-pipeline schedule
+    (ref: fwd_bwd_pipelining_with_interleaving.py:26-415) — lands with the
+    virtual-chunk engine; until then the non-interleaved 1F1B schedule is the
+    supported path."""
+    raise NotImplementedError(
+        "interleaved virtual-pipeline schedule is not implemented yet; use "
+        "forward_backward_pipelining_without_interleaving"
+    )

@@ -49,15 +49,7 @@ def vocab_parallel_cross_entropy(
     axis_name: str = TENSOR_AXIS,
 ) -> jax.Array:
     """Per-token CE loss over vocab-sharded logits. Returns (...,) fp32."""
-    loss, (softmax_local, in_range, _) = _fwd_math(
-        logits, target, vocab_size, axis_name
-    )
-    if label_smoothing > 0:
-        # ref :80-89: smoothed loss mixes the mean log-prob over the vocab
-        log_probs = jnp.log(jnp.maximum(softmax_local, 1e-30))
-        mean_log = jax.lax.psum(jnp.sum(log_probs, axis=-1), axis_name) / vocab_size
-        loss = (1.0 - label_smoothing) * loss - label_smoothing * mean_log
-    return loss
+    return _ce_fwd(logits, target, vocab_size, label_smoothing, axis_name)[0]
 
 
 def _ce_fwd(logits, target, vocab_size, label_smoothing, axis_name):

@@ -31,7 +31,7 @@ class MemoryBuffer:
     def get(self, shape: Tuple[int, ...], start_index: int) -> jax.Array:
         """View of the buffer at [start, start+prod(shape)) reshaped to shape."""
         n = math.prod(shape)
-        if start_index + n > self.numel:
+        if start_index < 0 or start_index + n > self.numel:
             raise ValueError(
                 f"requested {n} elements at offset {start_index} exceeds buffer "
                 f"size {self.numel}"

@@ -1,0 +1,255 @@
+"""Pipeline-parallel schedules: the identical-losses-across-layouts oracle.
+
+Port of the reference's key test
+(tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py:95-238): the same
+model run as no-pipelining vs 1F1B (and with TP mixed in) must produce
+identical losses and gradients. Plus microbatch-calculator unit tests
+(test_microbatches.py) and p2p ring semantics (test_p2p_comm.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from beforeholiday_tpu.transformer import pipeline_parallel as pp
+from beforeholiday_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+
+
+def shard_map(f=None, **kw):
+    kw.setdefault("check_vma", False)
+    if f is None:
+        return lambda g: jax.shard_map(g, **kw)
+    return jax.shard_map(f, **kw)
+
+
+# --- a toy homogeneous-stage model: each stage is one dense+gelu block ----------
+# (the oracle needs stages with identical input/output shapes, the reference's
+# fixed tensor_shape contract)
+
+HIDDEN = 8
+MICRO = 4  # microbatch rows
+
+
+def stage_fn(stage_params, x):
+    h = x @ stage_params["w"] + stage_params["b"]
+    return jax.nn.gelu(h) + x  # residual keeps shapes stable
+
+
+def loss_fn(y, tgt):
+    return jnp.mean((y - tgt) ** 2)
+
+
+def init_stages(key, n_stages):
+    keys = jax.random.split(key, n_stages)
+    return {
+        "w": jnp.stack(
+            [jax.random.normal(k, (HIDDEN, HIDDEN)) * 0.3 for k in keys]
+        ),
+        "b": jnp.zeros((n_stages, HIDDEN)),
+    }
+
+
+def sequential_reference(stacked, inputs, targets):
+    """Ground truth: run all stages sequentially, mean loss over microbatches."""
+    M = inputs.shape[0]
+
+    def full_model(stacked, x):
+        def body(h, sp):
+            return stage_fn(sp, h), None
+
+        h, _ = jax.lax.scan(body, x, stacked)
+        return h
+
+    def total_loss(stacked):
+        losses = jax.vmap(lambda x, t: loss_fn(full_model(stacked, x), t))(
+            inputs, targets
+        )
+        return jnp.mean(losses)
+
+    return jax.value_and_grad(total_loss)(stacked)
+
+
+@pytest.fixture
+def data(devices8):
+    rng = np.random.RandomState(0)
+    M = 6
+    inputs = jnp.asarray(rng.randn(M, MICRO, HIDDEN), jnp.float32)
+    targets = jnp.asarray(rng.randn(M, MICRO, HIDDEN), jnp.float32)
+    return inputs, targets
+
+
+class TestSchedulesOracle:
+    @pytest.mark.parametrize("n_stages", [2, 4])
+    def test_1f1b_matches_sequential(self, devices8, data, n_stages):
+        inputs, targets = data
+        stacked = init_stages(jax.random.PRNGKey(1), n_stages)
+        ref_loss, ref_grads = sequential_reference(stacked, inputs, targets)
+
+        mesh = Mesh(np.asarray(devices8[:n_stages]), ("pipe",))
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P("pipe"), P(), P()), out_specs=(P(), P("pipe")),
+        )
+        def run(stacked_local, inputs, targets):
+            sp = jax.tree.map(lambda v: v[0], stacked_local)  # local stage slice
+            loss, grads = pp.forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, sp, inputs, targets
+            )
+            return loss, jax.tree.map(lambda g: g[None], grads)
+
+        loss, grads = run(stacked, inputs, targets)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(ref_grads[k]), rtol=1e-4, atol=1e-5
+            )
+
+    def test_no_pipelining_matches_sequential(self, data):
+        inputs, targets = data
+        stacked = init_stages(jax.random.PRNGKey(2), 3)
+        ref_loss, ref_grads = sequential_reference(stacked, inputs, targets)
+
+        def full_model(stacked, x):
+            def body(h, sp):
+                return stage_fn(sp, h), None
+
+            h, _ = jax.lax.scan(body, x, stacked)
+            return h
+
+        loss, grads = pp.forward_backward_no_pipelining(
+            full_model, loss_fn, stacked, inputs, targets
+        )
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(ref_grads[k]), rtol=1e-5, atol=1e-6
+            )
+
+    def test_dispatcher(self):
+        f = pp.get_forward_backward_func(None, 1)
+        assert f is pp.forward_backward_no_pipelining
+        f = pp.get_forward_backward_func(None, 4)
+        assert f is pp.forward_backward_pipelining_without_interleaving
+        f = pp.get_forward_backward_func(2, 4)
+        assert f is pp.forward_backward_pipelining_with_interleaving
+
+    def test_interleaved_not_implemented_yet(self):
+        with pytest.raises(NotImplementedError):
+            pp.forward_backward_pipelining_with_interleaving()
+
+    def test_1f1b_with_tp_inside_stage(self, devices8, data):
+        """(tp=2, pp=2): TP column/row linear inside each pipeline stage still
+        matches the sequential dense reference — the reference oracle's
+        mixed-layout case."""
+        from beforeholiday_tpu.transformer import tensor_parallel as tp
+
+        inputs, targets = data
+        stacked = init_stages(jax.random.PRNGKey(3), 2)
+        ref_loss, ref_grads = sequential_reference(stacked, inputs, targets)
+
+        mesh = Mesh(np.asarray(devices8[:4]).reshape(2, 2), ("pipe", "tensor"))
+
+        def tp_stage_fn(sp, x):
+            # column-shard the dense: w local (H, H/2), gather output
+            h = tp.column_parallel_linear(
+                x, sp["w"], sp["b"], gather_output=True, axis_name="tensor"
+            )
+            return jax.nn.gelu(h) + x
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P("pipe"), P(), P()), out_specs=(P(), P("pipe", "tensor")),
+        )
+        def run(stacked_local, inputs, targets):
+            tr = jax.lax.axis_index("tensor")
+            sp = jax.tree.map(lambda v: v[0], stacked_local)
+            half = HIDDEN // 2
+            sp_local = {
+                "w": jax.lax.dynamic_slice_in_dim(sp["w"], tr * half, half, axis=1),
+                "b": jax.lax.dynamic_slice_in_dim(sp["b"], tr * half, half),
+            }
+            loss, grads = pp.forward_backward_pipelining_without_interleaving(
+                tp_stage_fn, loss_fn, sp_local, inputs, targets
+            )
+            return loss, jax.tree.map(lambda g: g[None, None], grads)
+
+        loss, grads = run(stacked, inputs, targets)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        # grads come back stacked (pipe, tensor, ...): reassemble the col shards
+        gw = np.asarray(grads["w"])  # (2, 2, H, H/2)
+        gw_full = np.concatenate([gw[:, 0], gw[:, 1]], axis=-1)
+        np.testing.assert_allclose(
+            gw_full, np.asarray(ref_grads["w"]), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestMicrobatchCalculators:
+    def test_constant(self):
+        c = pp.build_num_microbatches_calculator(64, 4, 2)
+        assert c.get() == 8
+        assert c.get_current_global_batch_size() == 64
+        c.update(10_000, True)
+        assert c.get() == 8
+
+    def test_constant_indivisible_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            pp.build_num_microbatches_calculator(65, 4, 2)
+
+    def test_rampup(self):
+        c = pp.build_num_microbatches_calculator(64, 4, 2, rampup_batch_size=[16, 8, 600])
+        assert c.get_current_global_batch_size() == 16
+        assert c.get() == 2
+        c.update(300, True)  # halfway: 16 + 3*8 = 40
+        assert c.get_current_global_batch_size() == 40
+        c.update(600, True)
+        assert c.get_current_global_batch_size() == 64
+        c.update(10_000, True)
+        assert c.get_current_global_batch_size() == 64
+        assert c.get() == 8
+
+    def test_rampup_validation(self):
+        with pytest.raises(ValueError, match="rampup_batch_size"):
+            pp.build_num_microbatches_calculator(64, 4, 2, rampup_batch_size=[16, 8])
+
+
+class TestP2P:
+    def test_forward_ring(self, devices8):
+        mesh = Mesh(np.asarray(devices8[:4]), ("pipe",))
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=P("pipe"), out_specs=P("pipe"))
+        def f(x):
+            return p2p.send_forward_recv_forward(x, axis_name="pipe")
+
+        out = np.asarray(jax.jit(f)(jnp.arange(4, dtype=jnp.float32)))
+        np.testing.assert_allclose(out, [3, 0, 1, 2])  # each got prev stage's value
+
+    def test_backward_ring(self, devices8):
+        mesh = Mesh(np.asarray(devices8[:4]), ("pipe",))
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=P("pipe"), out_specs=P("pipe"))
+        def f(x):
+            return p2p.send_backward_recv_backward(x, axis_name="pipe")
+
+        out = np.asarray(jax.jit(f)(jnp.arange(4, dtype=jnp.float32)))
+        np.testing.assert_allclose(out, [1, 2, 3, 0])  # each got next stage's value
+
+    def test_steady_state_pair(self, devices8):
+        mesh = Mesh(np.asarray(devices8[:4]), ("pipe",))
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P("pipe"), P("pipe")),
+            out_specs=(P("pipe"), P("pipe")),
+        )
+        def f(y, dy):
+            return p2p.send_forward_recv_backward(y, dy, axis_name="pipe")
+
+        y, dy = jax.jit(f)(jnp.arange(4.0), jnp.arange(4.0) * 10)
+        np.testing.assert_allclose(np.asarray(y), [3, 0, 1, 2])
+        np.testing.assert_allclose(np.asarray(dy), [10, 20, 30, 0])
