@@ -96,7 +96,11 @@ def initialize_model_parallel(
     dev_array = np.asarray(devices, dtype=object).reshape(pp, dp, cp, tp)
     mesh = Mesh(dev_array, MESH_AXIS_NAMES)
 
-    global _GLOBAL_STATE
+    global _GLOBAL_STATE, _VIRTUAL_PIPELINE_RANK
+    if virtual_pipeline_model_parallel_size is not None:
+        # ref: parallel_state.py initializes the virtual rank to 0 alongside
+        # the world size; the interleaved schedule advances it per chunk
+        _VIRTUAL_PIPELINE_RANK = 0
     _GLOBAL_STATE = ParallelState(
         mesh=mesh,
         tensor_model_parallel_size=tp,
@@ -111,8 +115,9 @@ def initialize_model_parallel(
 
 def destroy_model_parallel() -> None:
     """Drop global state (ref: parallel_state.py:627-654 ``destroy_model_parallel``)."""
-    global _GLOBAL_STATE
+    global _GLOBAL_STATE, _VIRTUAL_PIPELINE_RANK
     _GLOBAL_STATE = None
+    _VIRTUAL_PIPELINE_RANK = None
 
 
 def model_parallel_is_initialized() -> bool:
@@ -223,14 +228,83 @@ def get_context_parallel_rank():
     return _axis_index_or_zero(CONTEXT_AXIS)
 
 
-def is_pipeline_first_stage():
-    """Traced predicate (ref: parallel_state.py:446 ``is_pipeline_first_stage``)."""
+# --- virtual (interleaved) pipeline rank ---------------------------------------
+#
+# The interleaved schedule walks each device through several model chunks; the
+# reference tracks "which chunk am I executing" in module-global state
+# (ref: parallel_state.py:482-499). The schedule engine sets this around each
+# chunk's forward/backward.
+
+_VIRTUAL_PIPELINE_RANK: Optional[int] = None
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    """Ref: parallel_state.py:482 ``get_virtual_pipeline_model_parallel_rank``."""
+    return _VIRTUAL_PIPELINE_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    """Ref: parallel_state.py:489 ``set_virtual_pipeline_model_parallel_rank``."""
+    global _VIRTUAL_PIPELINE_RANK
+    _VIRTUAL_PIPELINE_RANK = rank
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced predicate (ref: parallel_state.py:446-456): with a virtual
+    pipeline, only virtual chunk 0 on pipe rank 0 is the true first stage.
+    The virtual rank is initialized to 0 by initialize_model_parallel (as the
+    reference does) and advanced by the interleaved schedule."""
+    if not ignore_virtual:
+        vpp = get_virtual_pipeline_model_parallel_world_size()
+        if vpp is not None and _VIRTUAL_PIPELINE_RANK != 0:
+            return False
     return get_pipeline_model_parallel_rank() == 0
 
 
-def is_pipeline_last_stage():
-    """Ref: parallel_state.py:458 ``is_pipeline_last_stage``."""
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    """Ref: parallel_state.py:458-471: with a virtual pipeline, only the last
+    virtual chunk on the last pipe rank is the true last stage."""
+    if not ignore_virtual:
+        vpp = get_virtual_pipeline_model_parallel_world_size()
+        if (
+            vpp is not None
+            and _VIRTUAL_PIPELINE_RANK is not None
+            and _VIRTUAL_PIPELINE_RANK != vpp - 1
+        ):
+            return False
     return get_pipeline_model_parallel_rank() == get_pipeline_model_parallel_world_size() - 1
+
+
+# --- encoder/decoder split-rank predicates (ref: parallel_state.py:502-560) ------
+
+
+def is_pipeline_stage_before_split(rank=None):
+    """True if the stage holds encoder layers (ref: :502-516)."""
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    split = get_pipeline_model_parallel_split_rank()
+    if split is None:
+        return True
+    r = get_pipeline_model_parallel_rank() if rank is None else rank
+    return r < split
+
+
+def is_pipeline_stage_after_split(rank=None):
+    """True if the stage holds decoder layers (ref: :519-533)."""
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    split = get_pipeline_model_parallel_split_rank()
+    if split is None:
+        return True
+    r = get_pipeline_model_parallel_rank() if rank is None else rank
+    return r >= split
+
+
+def is_pipeline_stage_at_split():
+    """True on the boundary stage feeding encoder output to the decoder
+    (ref: :536-547)."""
+    rank = get_pipeline_model_parallel_rank()
+    return is_pipeline_stage_before_split(rank) & is_pipeline_stage_after_split(rank + 1)
 
 
 def get_pipeline_model_parallel_next_rank():

@@ -5,5 +5,17 @@ activation checkpointing. ``pipeline_parallel`` — schedules and stage
 communication. ``parallel_state`` lives in ``beforeholiday_tpu.parallel``.
 """
 
+from beforeholiday_tpu.transformer import functional  # noqa: F401
+from beforeholiday_tpu.transformer import layers  # noqa: F401
 from beforeholiday_tpu.transformer import pipeline_parallel  # noqa: F401
 from beforeholiday_tpu.transformer import tensor_parallel  # noqa: F401
+from beforeholiday_tpu.transformer.amp_grad_scaler import (  # noqa: F401
+    GradScaler,
+    reduce_found_inf,
+)
+from beforeholiday_tpu.transformer.enums import (  # noqa: F401
+    AttnMaskType,
+    AttnType,
+    LayerType,
+    ModelType,
+)

@@ -1,0 +1,45 @@
+"""Model-parallel grad scaler (ref: apex/transformer/amp/grad_scaler.py:21-119).
+
+The reference subclasses torch's GradScaler to allreduce the found-inf flag
+across the tensor- and pipeline-parallel groups (:51) — an overflow anywhere
+in the model must skip the step everywhere. Here the scaler is the amp
+``LossScaler`` plus one ``pmax`` over the model axes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.amp.scaler import LossScaler
+from beforeholiday_tpu.parallel.parallel_state import PIPE_AXIS, TENSOR_AXIS
+
+
+def reduce_found_inf(
+    found_inf, axis_names: Sequence[str] = (TENSOR_AXIS, PIPE_AXIS)
+) -> jax.Array:
+    """OR the overflow flag across model-parallel axes (ref: grad_scaler.py:51
+    ``torch.distributed.all_reduce(found_inf, MAX, model_parallel_group)``).
+    Must run inside shard_map with those axes bound."""
+    flag = jnp.asarray(found_inf, jnp.float32)
+    for axis in axis_names:
+        flag = jax.lax.pmax(flag, axis)
+    return flag != 0
+
+
+class GradScaler(LossScaler):
+    """LossScaler whose unscale/update see the model-parallel-global flag.
+
+    Use inside shard_map over a (pipe, tensor, ...) mesh; ``unscale`` returns
+    the globally-reduced found_inf so every rank skips in lockstep.
+    """
+
+    def __init__(self, *args, axis_names: Sequence[str] = (TENSOR_AXIS, PIPE_AXIS), **kw):
+        super().__init__(*args, **kw)
+        object.__setattr__(self, "axis_names", tuple(axis_names))
+
+    def unscale(self, grads, state, *, impl=None) -> Tuple[object, jax.Array]:
+        grads, found = super().unscale(grads, state, impl=impl)
+        return grads, reduce_found_inf(found, self.axis_names)

@@ -1,0 +1,5 @@
+"""Functional transformer ops (ref: apex/transformer/functional/)."""
+
+from beforeholiday_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax,
+)

@@ -1,0 +1,109 @@
+"""FusedScaleMaskSoftmax (ref: apex/transformer/functional/fused_softmax.py:21-274).
+
+The reference wraps the four megatron softmax kernels in a module that decides
+per-call whether the fused kernel applies (dtype, shape limits, mask type) and
+otherwise falls back to eager torch softmax (:164-274 ``FusedScaleMaskSoftmax``,
+``is_kernel_available``). The TPU port keeps the same decision surface over the
+Pallas kernel family in ``beforeholiday_tpu.ops.softmax``; the fallback is the
+jnp oracle path of the same ops, so both branches share one numeric contract.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.ops.softmax import (
+    _BR,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from beforeholiday_tpu.transformer.enums import AttnMaskType
+
+
+class FusedScaleMaskSoftmax:
+    """fused scale+mask+softmax with availability heuristics.
+
+    Args mirror the reference module: input dtypes, mask type, fusion toggle,
+    optional ``mask_func`` for the fallback, fp32 softmax option, fixed scale.
+    Call with scores (b, np, sq, sk) and optional mask (b, 1, sq, sk).
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = False,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError("both fp16 and bf16 flags cannot be active at the same time.")
+        if scale is not None and not softmax_in_fp32:
+            raise RuntimeError("softmax should be in fp32 when scaled")
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """Shape/dtype gate (ref: fused_softmax.py:194-231). The reference's
+        CUDA limits (16 < sk <= 16384, sq multiple of 4...) become the Pallas
+        tiling constraints: causal needs sq % 128 == 0 and square scores."""
+        if not self.scaled_masked_softmax_fusion:
+            return False
+        if not self.input_in_float16:
+            # the reference only fuses half-precision inputs; fp32 goes eager
+            return False
+        if sk > 16384 or sk <= 0:
+            return False
+        if self.attn_mask_type == AttnMaskType.causal:
+            return sq == sk and (sq % _BR == 0)
+        return True
+
+    def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+        assert x.ndim == 4, "expected (b, np, sq, sk) attention scores"
+        b, np_, sq, sk = x.shape
+        scale = self.scale if self.scale is not None else 1.0
+
+        if self.is_kernel_available(mask, b, np_, sq, sk):
+            return self.forward_fused_softmax(x, mask, scale)
+        return self.forward_jnp_softmax(x, mask, scale)
+
+    def forward_fused_softmax(self, x, mask, scale):
+        """Kernel path (ref: fused_softmax.py:233-259)."""
+        if self.attn_mask_type == AttnMaskType.causal:
+            y = scaled_upper_triang_masked_softmax(
+                x.reshape(-1, x.shape[-2], x.shape[-1]), scale
+            )
+            return y.reshape(x.shape)
+        if mask is not None:
+            return scaled_masked_softmax(x, mask, scale)
+        return scaled_softmax(x, scale)
+
+    def forward_jnp_softmax(self, x, mask, scale):
+        """Eager fallback (ref: fused_softmax.py:261-274 forward_torch_softmax)."""
+        xf = x.astype(jnp.float32) if self.softmax_in_fp32 else x
+        xf = xf * scale
+        if self.attn_mask_type == AttnMaskType.causal:
+            sq, sk = x.shape[-2], x.shape[-1]
+            causal = jnp.tril(jnp.ones((sq, sk), bool))
+            xf = jnp.where(causal, xf, -10000.0)
+        if mask is not None:
+            if self.mask_func is not None:
+                xf = self.mask_func(xf, mask)
+            else:
+                xf = jnp.where(mask != 0, -10000.0, xf)
+        probs = jax.nn.softmax(xf, axis=-1)
+        if self.softmax_in_fp32 and self.input_in_float16:
+            probs = probs.astype(x.dtype)
+        return probs

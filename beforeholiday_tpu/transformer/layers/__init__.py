@@ -1,0 +1,6 @@
+"""SP-aware transformer layers (ref: apex/transformer/layers/)."""
+
+from beforeholiday_tpu.transformer.layers.layer_norm import (  # noqa: F401
+    sp_fused_layer_norm,
+    sp_fused_rms_norm,
+)

@@ -1,0 +1,82 @@
+"""Sequence-parallel-aware layer norms (ref: apex/transformer/layers/layer_norm.py:33-99).
+
+The reference wraps FusedLayerNorm to tag gamma/beta with a
+``sequence_parallel_enabled`` attribute; the DDP grad pass then allreduces
+those grads across the TP group, because under SP each rank normalizes only
+its sequence shard and the param grads are partial sums
+(layer_norm.py:26-31 comment). Attributes don't exist on functional params, so
+the semantic lands where it belongs: a custom VJP that psums dgamma/dbeta over
+the tensor axis when ``sequence_parallel`` is on. dx stays local (each rank
+owns its tokens).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+from beforeholiday_tpu.ops.normalization import fused_layer_norm, fused_rms_norm
+from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
+
+
+def _sp_param_grads(norm_fn):
+    """Wrap a (x, scale, bias?) norm into an SP-aware one."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+    def wrapped(x, scale, bias, eps, axis_name):
+        return norm_fn(x, scale, bias, eps)
+
+    def fwd(x, scale, bias, eps, axis_name):
+        out, vjp = jax.vjp(lambda x_, s_, b_: norm_fn(x_, s_, b_, eps), x, scale, bias)
+        return out, vjp
+
+    def bwd(eps, axis_name, vjp, dy):
+        dx, dscale, dbias = vjp(dy)
+        # partial param grads: every TP rank saw only its sequence shard
+        dscale = jax.lax.psum(dscale, axis_name)
+        dbias = jax.lax.psum(dbias, axis_name)
+        return dx, dscale, dbias
+
+    wrapped.defvjp(fwd, bwd)
+    return wrapped
+
+
+_sp_ln = _sp_param_grads(
+    lambda x, s, b, eps: fused_layer_norm(x, s, b, eps=eps)
+)
+_sp_rms = _sp_param_grads(
+    lambda x, s, b, eps: fused_rms_norm(x, s, eps=eps) + 0.0 * b.sum()
+)
+
+
+def sp_fused_layer_norm(
+    x: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    *,
+    eps: float = 1e-5,
+    sequence_parallel: bool = False,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    """FusedLayerNorm whose param grads are TP-allreduced under SP
+    (the functional form of the ``sequence_parallel_enabled`` tag)."""
+    if not sequence_parallel:
+        return fused_layer_norm(x, scale, bias, eps=eps)
+    return _sp_ln(x, scale, bias, eps, axis_name)
+
+
+def sp_fused_rms_norm(
+    x: jax.Array,
+    scale: jax.Array,
+    *,
+    eps: float = 1e-5,
+    sequence_parallel: bool = False,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    if not sequence_parallel:
+        return fused_rms_norm(x, scale, eps=eps)
+    import jax.numpy as jnp
+
+    return _sp_rms(x, scale, jnp.zeros((), x.dtype), eps, axis_name)

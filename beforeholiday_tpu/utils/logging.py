@@ -17,7 +17,16 @@ _LOG_ENV = "BEFOREHOLIDAY_TPU_LOG_LEVEL"
 
 
 class _ProcessInfoFormatter(logging.Formatter):
-    """Prefixes records with the JAX process index (multi-host) and layout."""
+    """Prefixes records with process index and the (dp, tp, pp, cp) layout.
+
+    The reference's RankInfoFormatter pulls the rank tuple from
+    ``parallel_state.get_rank_info`` (ref: apex/__init__.py:27-39). Device
+    ranks are traced values under SPMD, so host-side records carry the process
+    index plus the *sizes* of each parallel axis — which identifies the layout
+    the way the reference's per-process tuple does per rank.
+    """
+
+    _layout_cache = (None, "")  # (ParallelState identity, formatted string)
 
     def format(self, record):
         try:
@@ -27,7 +36,27 @@ class _ProcessInfoFormatter(logging.Formatter):
             nprocs = jax.process_count()
         except Exception:
             proc, nprocs = 0, 1
-        record.rankinfo = f"p{proc}/{nprocs}"
+        layout = ""
+        try:
+            from beforeholiday_tpu.parallel import parallel_state as ps
+
+            if ps.model_parallel_is_initialized():
+                st = ps.get_state()
+                cached_st, cached = self._layout_cache
+                if cached_st is st:
+                    layout = cached
+                else:
+                    # ASCII separators: the record must survive ASCII-encoded
+                    # handlers on bare-locale pod hosts
+                    layout = (
+                        f" dp{st.data_parallel_size}xtp{st.tensor_model_parallel_size}"
+                        f"xpp{st.pipeline_model_parallel_size}"
+                        f"xcp{st.context_parallel_size}"
+                    )
+                    self._layout_cache = (st, layout)
+        except Exception:
+            pass
+        record.rankinfo = f"p{proc}/{nprocs}{layout}"
         return super().format(record)
 
 
