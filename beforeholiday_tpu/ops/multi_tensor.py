@@ -49,19 +49,35 @@ def _nonfinite_any(x) -> jax.Array:
     return jnp.any(~jnp.isfinite(x))
 
 
-def _segment_coef(values_per_tensor: jax.Array, spec: ArenaSpec) -> jax.Array:
-    """Gather a per-tensor value to a per-element arena vector (static table)."""
-    seg = jnp.asarray(spec.segment_ids())
+def _segment_coef(
+    values_per_tensor: jax.Array, spec: ArenaSpec, segment_ids=None
+) -> jax.Array:
+    """Gather a per-tensor value to a per-element arena vector.
+
+    ``segment_ids`` overrides the spec's static table (ZeRO mode: ids for one
+    arena shard)."""
+    seg = jnp.asarray(spec.segment_ids()) if segment_ids is None else segment_ids
     padded = jnp.concatenate([values_per_tensor, jnp.zeros((1,), values_per_tensor.dtype)])
     return padded[seg]
 
 
-def per_tensor_sumsq(flat: jax.Array, spec: ArenaSpec) -> jax.Array:
-    """Per-tensor sum of squares over the arena (ref: per-tensor l2norm outputs)."""
-    seg = jnp.asarray(spec.segment_ids())
+def per_tensor_sumsq(
+    flat: jax.Array, spec: ArenaSpec, segment_ids=None, axis_name=None,
+    num_tensors=None,
+) -> jax.Array:
+    """Per-tensor sum of squares over the arena (ref: per-tensor l2norm outputs).
+
+    With ``segment_ids``/``axis_name`` set, ``flat`` is one shard of the arena
+    and the partial sums are psum'd across the axis (ZeRO mode) —
+    ``num_tensors`` must then be the ORIGINAL tensor count (the shard's own
+    spec sees one flat tensor)."""
+    seg = jnp.asarray(spec.segment_ids()) if segment_ids is None else segment_ids
+    n = spec.num_tensors if num_tensors is None else num_tensors
     x = flat.astype(jnp.float32)
-    sums = jax.ops.segment_sum(x * x, seg, num_segments=spec.num_tensors + 1)
-    return sums[:-1]
+    sums = jax.ops.segment_sum(x * x, seg, num_segments=n + 1)[:-1]
+    if axis_name is not None:
+        sums = jax.lax.psum(sums, axis_name)
+    return sums
 
 
 # ---------------------------------------------------------------------------------
@@ -385,13 +401,17 @@ def multi_tensor_lamb(
     beta2: float = 0.999, eps: float = 1e-6, step=1, bias_correction: bool = True,
     weight_decay: float = 0.0, grad_averaging: bool = True, mode: int = 1,
     global_grad_norm=None, max_grad_norm: float = 1.0, use_nvlamb: bool = False,
-    found_inf=None, impl: Optional[str] = None,
+    found_inf=None, impl: Optional[str] = None, _sharded_norms=None,
 ):
     """Fused LAMB. Returns (params, m, v).
 
     Stage 1 computes the Adam-style update; per-tensor ``||p||``/``||u||`` trust
     ratios then rescale the lr per tensor (nvlamb: for every tensor; otherwise
     only tensors with weight decay — ref: multi_tensor_lamb.cu:255-263).
+
+    ``_sharded_norms``: (segment_ids_local, num_tensors, axis_name) — ZeRO
+    mode, where the tensor list is ONE arena shard and per-tensor norms must
+    be psum'd across the data axis (the DistributedFusedLAMB norm allreduce).
     """
     impl = _resolve(impl)
     gf, spec = flatten(grads)
@@ -434,8 +454,11 @@ def multi_tensor_lamb(
         m_new, v_new = m_new.astype(mf.dtype), v_new.astype(vf.dtype)
 
     # per-tensor trust ratios (stage 2)
-    p_norm = jnp.sqrt(per_tensor_sumsq(pf, spec))
-    u_norm = jnp.sqrt(per_tensor_sumsq(u, spec))
+    seg_local, norm_axis, n_tensors = (None, None, None)
+    if _sharded_norms is not None:
+        seg_local, n_tensors, norm_axis = _sharded_norms
+    p_norm = jnp.sqrt(per_tensor_sumsq(pf, spec, seg_local, norm_axis, n_tensors))
+    u_norm = jnp.sqrt(per_tensor_sumsq(u, spec, seg_local, norm_axis, n_tensors))
     apply_ratio = use_nvlamb or (weight_decay != 0.0)
     if apply_ratio:
         ratio_pt = jnp.where(
@@ -443,7 +466,7 @@ def multi_tensor_lamb(
         )
     else:
         ratio_pt = jnp.full_like(p_norm, lr)
-    coef = _segment_coef(ratio_pt, spec)
+    coef = _segment_coef(ratio_pt, spec, seg_local)
 
     if impl == "pallas":
         p_new = k.apply_scaled_update(pf, u, coef, found_inf=found_inf)

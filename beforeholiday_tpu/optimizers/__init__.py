@@ -1,5 +1,9 @@
 """Fused optimizers (ref: apex/optimizers/ + apex/contrib/optimizers/)."""
 
+from beforeholiday_tpu.optimizers.distributed_fused import (  # noqa: F401
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
 from beforeholiday_tpu.optimizers.fused import (  # noqa: F401
     MasterWeights,
     FusedAdagrad,
@@ -12,6 +16,8 @@ from beforeholiday_tpu.optimizers.fused import (  # noqa: F401
 )
 
 __all__ = [
+    "DistributedFusedAdam",
+    "DistributedFusedLAMB",
     "FusedAdagrad",
     "FusedAdam",
     "FusedLAMB",

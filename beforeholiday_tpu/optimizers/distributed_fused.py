@@ -1,0 +1,230 @@
+"""ZeRO-2 sharded fused optimizers
+(ref: apex/contrib/optimizers/distributed_fused_adam.py:19-35, distributed_fused_lamb.py).
+
+The reference reduce-scatters flat grad buckets over the data-parallel group,
+keeps fp32 optimizer state (master params, moments) only for the local shard,
+runs the fused update on the shard, and all-gathers the updated params
+(:691-724 reduce-scatter, :914 sharded step, :1071-1076 all-gather), with
+communication overlapped on pipelined streams (:302).
+
+TPU design over the flat arena: params flatten into one buffer padded so every
+data-parallel rank owns an equal, TILE-aligned shard —
+
+    g_shard  = psum_scatter(grad_arena)/world     (one ICI reduce-scatter)
+    state    = {master, m, v} fp32, shard-sized   (1/world of the memory)
+    update   = the same multi-tensor Adam/LAMB kernel, on the shard
+    params   = all_gather(master_shard.astype(param_dtype))
+
+XLA's latency-hiding scheduler overlaps the collectives with surrounding
+compute — the stream pipelining the reference hand-builds. All functions run
+inside ``shard_map`` with the data axis bound (``check_vma=False``), taking
+*local unreduced* grads exactly like ``reduce_gradients``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.ops import multi_tensor as mt
+from beforeholiday_tpu.ops.arena import TILE, flatten, make_spec, unflatten
+from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
+
+
+def _shard_len(total_padded: int, world: int) -> int:
+    """Per-rank arena shard, TILE-aligned so the pallas kernels tile cleanly."""
+    per = -(-total_padded // world)  # ceil
+    return -(-per // TILE) * TILE
+
+
+def _pad_to(flat: jax.Array, n: int) -> jax.Array:
+    if flat.shape[0] == n:
+        return flat
+    return jnp.concatenate([flat, jnp.zeros((n - flat.shape[0],), flat.dtype)])
+
+
+class _DistributedFused:
+    """Shared arena/collective machinery for the sharded optimizers."""
+
+    def __init__(self, *, axis_name: str = DATA_AXIS, grad_average: bool = True):
+        self.axis_name = axis_name
+        self.grad_average = grad_average
+
+    def _world(self):
+        return jax.lax.axis_size(self.axis_name)
+
+    def _arena_layout(self, params) -> Tuple[Any, Any, int, int]:
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        spec = make_spec(leaves)
+        world = self._world()
+        shard = _shard_len(spec.padded_total, world)
+        return leaves, treedef, spec, shard
+
+    def init(self, params):
+        """Local fp32 state shard. Must run inside shard_map (data axis bound)."""
+        leaves, treedef, spec, shard = self._arena_layout(params)
+        flat, _ = flatten(leaves, dtype=jnp.float32)
+        flat = _pad_to(flat, shard * self._world())
+        rank = jax.lax.axis_index(self.axis_name)
+        master = jax.lax.dynamic_slice_in_dim(flat, rank * shard, shard)
+        state = {
+            "master": master,
+            "step": jnp.zeros((), jnp.int32),
+        }
+        for key in self._state_keys():
+            state[key] = jnp.zeros((shard,), jnp.float32)
+        return state
+
+    def _reduce_scatter_grads(self, grads, spec, shard):
+        gleaves = jax.tree_util.tree_leaves(grads)
+        gflat, _ = flatten(gleaves, dtype=jnp.float32)
+        gflat = _pad_to(gflat, shard * self._world())
+        g_shard = jax.lax.psum_scatter(
+            gflat, self.axis_name, scatter_dimension=0, tiled=True
+        )
+        if self.grad_average:
+            g_shard = g_shard / self._world()
+        return g_shard
+
+    def _gather_params(self, master_shard, params, spec):
+        full = jax.lax.all_gather(master_shard, self.axis_name, axis=0, tiled=True)
+        full = full[: spec.padded_total]
+        leaves = jax.tree_util.tree_leaves(params)
+        new_leaves = [
+            piece.astype(leaf.dtype)
+            for piece, leaf in zip(unflatten(full, spec), leaves)
+        ]
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params), new_leaves
+        )
+
+    def _global_found_inf(self, g_shard, found_inf):
+        local_bad = jnp.any(~jnp.isfinite(g_shard))
+        flag = local_bad if found_inf is None else (
+            local_bad | (jnp.asarray(found_inf) != 0)
+        )
+        return jax.lax.pmax(flag.astype(jnp.float32), self.axis_name) != 0
+
+
+class DistributedFusedAdam(_DistributedFused):
+    """ZeRO-2 AdamW (ref: apex/contrib/optimizers/distributed_fused_adam.py:19)."""
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        *,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        bias_correction: bool = True,
+        axis_name: str = DATA_AXIS,
+        grad_average: bool = True,
+        impl: Optional[str] = None,
+    ):
+        super().__init__(axis_name=axis_name, grad_average=grad_average)
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.impl = impl
+
+    def _state_keys(self):
+        return ("exp_avg", "exp_avg_sq")
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        lr = self.lr if lr is None else lr
+        leaves, treedef, spec, shard = self._arena_layout(params)
+        g_shard = self._reduce_scatter_grads(grads, spec, shard) * grad_scale
+        flag = self._global_found_inf(g_shard, found_inf)
+        step_no = jnp.where(flag, state["step"], state["step"] + 1)
+
+        [p2], [m2], [v2] = mt.multi_tensor_adam(
+            [g_shard], [state["master"]], [state["exp_avg"]], [state["exp_avg_sq"]],
+            lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            step=step_no, adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction, weight_decay=self.weight_decay,
+            found_inf=flag, impl=self.impl,
+        )
+        new_params = self._gather_params(p2, params, spec)
+        return new_params, {
+            "master": p2, "exp_avg": m2, "exp_avg_sq": v2, "step": step_no,
+        }
+
+
+class DistributedFusedLAMB(_DistributedFused):
+    """ZeRO-sharded LAMB (ref: apex/contrib/optimizers/distributed_fused_lamb.py).
+
+    Per-tensor trust ratios need cross-shard norms: the shard's per-tensor
+    partial sums (via a rank-sliced segment table) are psum'd over the data
+    axis, reproducing the reference's L2-norm allreduce before stage 2.
+    """
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-6,
+        *,
+        weight_decay: float = 0.01,
+        bias_correction: bool = True,
+        grad_averaging: bool = True,
+        adam_w_mode: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        axis_name: str = DATA_AXIS,
+        grad_average: bool = True,
+        impl: Optional[str] = None,
+    ):
+        super().__init__(axis_name=axis_name, grad_average=grad_average)
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.grad_averaging = grad_averaging
+        self.adam_w_mode = adam_w_mode
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+        self.impl = impl
+
+    def _state_keys(self):
+        return ("exp_avg", "exp_avg_sq")
+
+    def _local_segment_ids(self, spec, shard):
+        """This rank's arena→tensor segment ids, computed O(shard): offsets are
+        a static sorted table, so searchsorted recovers the owning tensor of
+        each global index without ever materializing the full-arena table
+        (which would be an O(model) replicated buffer defeating the sharding)."""
+        rank = jax.lax.axis_index(self.axis_name)
+        idx = rank * shard + jnp.arange(shard)
+        offsets = jnp.asarray(spec.offsets)
+        seg = jnp.searchsorted(offsets, idx, side="right") - 1
+        return jnp.where(idx < spec.total, seg, spec.num_tensors).astype(jnp.int32)
+
+    def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
+        lr = self.lr if lr is None else lr
+        leaves, treedef, spec, shard = self._arena_layout(params)
+        seg_local = self._local_segment_ids(spec, shard)
+        g_shard = self._reduce_scatter_grads(grads, spec, shard) * grad_scale
+        flag = self._global_found_inf(g_shard, found_inf)
+        step_no = jnp.where(flag, state["step"], state["step"] + 1)
+
+        # global grad norm for clipping (ref: fused_lamb step's l2norm)
+        gnorm = jnp.sqrt(
+            jax.lax.psum(jnp.sum(g_shard.astype(jnp.float32) ** 2), self.axis_name)
+        )
+        [p2], [m2], [v2] = mt.multi_tensor_lamb(
+            [g_shard], [state["master"]], [state["exp_avg"]], [state["exp_avg_sq"]],
+            lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            step=step_no, bias_correction=self.bias_correction,
+            weight_decay=self.weight_decay, grad_averaging=self.grad_averaging,
+            mode=1 if self.adam_w_mode else 0, global_grad_norm=gnorm,
+            max_grad_norm=self.max_grad_norm, use_nvlamb=self.use_nvlamb,
+            found_inf=flag, impl=self.impl,
+            _sharded_norms=(seg_local, spec.num_tensors, self.axis_name),
+        )
+        new_params = self._gather_params(p2, params, spec)
+        return new_params, {
+            "master": p2, "exp_avg": m2, "exp_avg_sq": v2, "step": step_no,
+        }

@@ -101,6 +101,8 @@ def initialize_model_parallel(
         # ref: parallel_state.py initializes the virtual rank to 0 alongside
         # the world size; the interleaved schedule advances it per chunk
         _VIRTUAL_PIPELINE_RANK = 0
+    else:
+        _VIRTUAL_PIPELINE_RANK = None  # re-init without vpp clears stale rank
     _GLOBAL_STATE = ParallelState(
         mesh=mesh,
         tensor_model_parallel_size=tp,

@@ -82,6 +82,9 @@ class FusedScaleMaskSoftmax:
     def forward_fused_softmax(self, x, mask, scale):
         """Kernel path (ref: fused_softmax.py:233-259)."""
         if self.attn_mask_type == AttnMaskType.causal:
+            # the reference asserts mask is None on the causal kernel path —
+            # silently ignoring a padding mask would change numerics by shape
+            assert mask is None, "causal fused softmax does not accept a mask"
             y = scaled_upper_triang_masked_softmax(
                 x.reshape(-1, x.shape[-2], x.shape[-1]), scale
             )
