@@ -181,8 +181,16 @@ def generic_scaled_masked_softmax(
     x: jax.Array, mask: jax.Array, scale: float = 1.0, *, impl: Optional[str] = None
 ):
     """Arbitrary-shape scale+mask+softmax (ref: generic_scaled_masked_softmax_cuda).
-    Same math as scaled_masked_softmax without the 4D shape contract."""
-    return scaled_masked_softmax(x, mask, scale, impl=impl)
+
+    Same math as scaled_masked_softmax without the 4D shape contract, except
+    fully-masked rows: the generic CUDA kernel outputs all zeros for a row whose
+    every position is masked ("pay attention to nothing",
+    ref: csrc/megatron/generic_scaled_masked_softmax.h:287-293), where the
+    non-generic variant yields uniform 1/sk."""
+    y = scaled_masked_softmax(x, mask, scale, impl=impl)
+    # reduced on the unbroadcast mask so no per-head intermediate materializes
+    all_masked = jnp.all(mask != 0, axis=-1, keepdims=True)
+    return jnp.where(all_masked, jnp.zeros((), y.dtype), y)
 
 
 def scaled_upper_triang_masked_softmax(

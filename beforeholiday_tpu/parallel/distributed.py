@@ -80,11 +80,18 @@ class Reducer:
         self.axis_name = axis_name
 
     def broadcast_params(self, params: Any) -> Any:
-        """Make params identical on every rank (mean across the axis — the
-        reference broadcasts rank 0; under SPMD init params are usually already
-        replicated, so the mean is an idempotent sync)."""
-        world = jax.lax.axis_size(self.axis_name)
-        return jax.tree.map(lambda p: jax.lax.psum(p, self.axis_name) / world, params)
+        """Make params exactly rank 0's values on every rank (ref:
+        distributed.py:254 broadcasts rank 0 at init). Implemented as a masked
+        psum — zero every rank's contribution except rank 0 — which is exact
+        both when ranks have diverged (the repair scenario broadcast exists
+        for) and when they are already replicated."""
+        is_src = jax.lax.axis_index(self.axis_name) == 0
+        return jax.tree.map(
+            lambda p: jax.lax.psum(
+                jnp.where(is_src, p, jnp.zeros((), p.dtype)), self.axis_name
+            ),
+            params,
+        )
 
     def reduce(self, tree: Any, average: bool = True) -> Any:
         return reduce_gradients(

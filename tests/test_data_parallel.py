@@ -156,6 +156,21 @@ class TestReduceGradients:
         out = np.asarray(jax.jit(f)(jnp.arange(8, dtype=jnp.float32)))
         np.testing.assert_allclose(out, np.full(8, np.arange(8).mean()))
 
+    def test_broadcast_params_selects_rank0_when_diverged(self, data_mesh):
+        """broadcast repairs divergence with rank 0's exact values, not a mean
+        (ref: apex/parallel/distributed.py:254)."""
+        r = Reducer()
+
+        @functools.partial(
+            shard_map, mesh=data_mesh, in_specs=(P("data"),), out_specs=P("data")
+        )
+        def f(p):
+            return r.broadcast_params({"w": p})["w"]
+
+        diverged = jnp.arange(8, dtype=jnp.float32) * 3.0 + 7.0  # rank i holds 3i+7
+        out = np.asarray(jax.jit(f)(diverged))
+        np.testing.assert_allclose(out, np.full(8, 7.0), atol=0)
+
 
 class TestSyncBatchNorm:
     def test_matches_torch_bn_over_full_batch(self, data_mesh):

@@ -196,6 +196,22 @@ class TestSoftmaxFamily:
         np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-6)
 
     @pytest.mark.parametrize("impl", IMPLS)
+    def test_generic_fully_masked_rows_are_zero(self, impl):
+        """Generic kernel contract: a fully-masked row attends to nothing —
+        all-zero output (ref: generic_scaled_masked_softmax.h:287-293), unlike
+        the non-generic variant's uniform 1/sk."""
+        rng = np.random.RandomState(42)
+        x = rng.randn(4, 32).astype(np.float32)
+        mask = (rng.rand(4, 32) > 0.5).astype(np.int8)
+        mask[2, :] = 1  # row 2 fully masked
+        got = np.asarray(
+            generic_scaled_masked_softmax(jnp.asarray(x), jnp.asarray(mask), 1.0, impl=impl)
+        )
+        np.testing.assert_allclose(got[2], np.zeros(32), atol=0)
+        # other rows still proper softmaxes
+        np.testing.assert_allclose(got[[0, 1, 3]].sum(-1), np.ones(3), rtol=1e-5)
+
+    @pytest.mark.parametrize("impl", IMPLS)
     def test_bwd_matches_torch(self, impl):
         rng = np.random.RandomState(11)
         x = rng.randn(4, 128, 128).astype(np.float32)
