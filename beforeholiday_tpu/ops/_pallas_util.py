@@ -13,18 +13,53 @@ def interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def resolve_impl(impl: Optional[str]) -> str:
-    """Pick the kernel implementation.
+def in_fully_manual_context() -> bool:
+    """True when tracing inside ``shard_map`` over every mesh axis with vma
+    tracking off (``check_vma=False``, the repo convention).
 
-    pallas_call is an opaque custom call to the GSPMD partitioner: under a
-    >1-device mesh it would force replication/all-gathers on sharded
-    activations. Default to pallas only single-device; the jnp path partitions
-    transparently. Explicit impl="pallas" is always honored.
+    There the per-shard program sees exactly one device, so an opaque
+    ``pallas_call`` needs no GSPMD partitioning — the safe (and fast) place
+    for fused kernels on a pod. Under ``check_vma=True`` (jax's default) a
+    pallas_call is rejected at trace time because its out_shapes carry no
+    ``vma``; the default must stay jnp there rather than regress working
+    user code."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if not mesh.axis_names:
+        return False
+    if not all(t == jax.sharding.AxisType.Manual for t in mesh.axis_types):
+        return False
+    try:
+        from jax._src.config import _check_vma
+    except ImportError:  # future jax relocation: fail safe to jnp
+        return False
+    return not _check_vma.value
+
+
+def resolve_impl(impl: Optional[str]) -> str:
+    """ONE dispatch policy for every fused op (multi_tensor / normalization /
+    softmax — the reference's per-extension availability checks,
+    e.g. fused_softmax.py:164 ``is_kernel_available``).
+
+    ``pallas_call`` is an opaque custom call to the GSPMD partitioner: under a
+    >1-device auto-sharded program it would force replication/all-gathers on
+    sharded operands. Default to pallas only where the traced program owns a
+    single device per shard:
+
+    * single-device TPU, or
+    * inside ``shard_map`` over ALL mesh axes (fully-manual context).
+
+    Anywhere else (GSPMD/auto axes, CPU/GPU) the jnp path partitions
+    transparently. Explicit ``impl=`` is always honored.
+
+    Note: inside shard_map the kernels require ``check_vma=False`` (the
+    repo-wide convention, see parallel/distributed.py) — jax's interpret-mode
+    vma tracking rejects pallas_call bodies (jax#: "pass check_vma=False").
     """
     if impl is None:
+        on_tpu = jax.default_backend() == "tpu"
         impl = (
             "pallas"
-            if jax.default_backend() == "tpu" and jax.device_count() == 1
+            if on_tpu and (jax.device_count() == 1 or in_fully_manual_context())
             else "jnp"
         )
     if impl not in ("pallas", "jnp"):

@@ -34,15 +34,7 @@ from . import _pallas_mt as k
 from .arena import ArenaSpec, flatten, make_spec, unflatten
 
 
-def _default_impl() -> str:
-    return "pallas" if jax.default_backend() == "tpu" else "jnp"
-
-
-def _resolve(impl: Optional[str]) -> str:
-    impl = impl or _default_impl()
-    if impl not in ("pallas", "jnp"):
-        raise ValueError(f"impl must be 'pallas' or 'jnp', got {impl!r}")
-    return impl
+from ._pallas_util import resolve_impl as _resolve
 
 
 def _nonfinite_any(x) -> jax.Array:
