@@ -10,6 +10,7 @@ from beforeholiday_tpu.amp.frontend import (  # noqa: F401
     MasterWeights,
     Properties,
     initialize,
+    make_apply,
     opt_levels,
     scaled_value_and_grad,
 )

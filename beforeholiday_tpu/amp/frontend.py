@@ -143,6 +143,7 @@ def initialize(
     master_weights: Optional[bool] = None,
     loss_scale: Optional[Any] = None,
     keep_fp32_mask: Optional[Callable] = None,
+    has_state: bool = False,
 ) -> AmpModel:
     """Apply an opt-level policy to (apply_fn, params, optimizer).
 
@@ -155,6 +156,12 @@ def initialize(
     ``AmpModel.apply`` casts floating inputs (and, per O1/O4 semantics, the
     fp32-stored params) to the compute dtype and the outputs to
     ``cast_model_outputs``.
+
+    ``has_state=True`` declares ``apply_fn(params, model_state, *inputs) ->
+    (out, new_model_state)`` — model buffers like BN running stats. The state
+    is passed through UNCAST in both directions: the reference's
+    ``convert_network`` never casts BN buffers (apex/fp16_utils/fp16util.py),
+    and low-precision round-trips would erode the running averages.
     """
     if opt_level not in opt_levels:
         raise RuntimeError(
@@ -174,19 +181,9 @@ def initialize(
     logger.info("amp.initialize: %s", policy)
 
     cast_params = _cast_params(params, policy, keep_fp32_mask)
-    compute_dtype = policy.compute_dtype
-
-    def amp_apply(p, *inputs, **kwinputs):
-        if policy.patch_torch_functions:
-            # O1/O4: fp32 storage, low-precision compute — the cast happens at
-            # the trace boundary and XLA fuses it (the "cast cache" for free)
-            p = _cast_floats(p, compute_dtype)
-        inputs = _cast_floats(inputs, compute_dtype)
-        kwinputs = _cast_floats(kwinputs, compute_dtype)
-        out = apply_fn(p, *inputs, **kwinputs)
-        if cast_model_outputs is not None:
-            out = _cast_floats(out, cast_model_outputs)
-        return out
+    amp_apply = make_apply(
+        policy, apply_fn, cast_model_outputs=cast_model_outputs, has_state=has_state
+    )
 
     opt = optimizer
     if opt is not None and policy.master_weights:
@@ -199,8 +196,43 @@ def initialize(
     )
 
 
+def make_apply(
+    policy: Properties,
+    apply_fn: Callable,
+    *,
+    cast_model_outputs: Optional[Any] = jnp.float32,
+    has_state: bool = False,
+) -> Callable:
+    """Wrap ``apply_fn`` with a policy's input/param/output casts WITHOUT
+    re-casting a params copy — for building extra apply variants (e.g. an
+    eval-mode forward) that share an existing ``AmpModel``'s params."""
+    compute_dtype = policy.compute_dtype
+
+    def amp_apply(p, *inputs, **kwinputs):
+        if has_state:
+            model_state, *inputs = inputs
+        if policy.patch_torch_functions:
+            # O1/O4: fp32 storage, low-precision compute — the cast happens at
+            # the trace boundary and XLA fuses it (the "cast cache" for free)
+            p = _cast_floats(p, compute_dtype)
+        inputs = _cast_floats(inputs, compute_dtype)
+        kwinputs = _cast_floats(kwinputs, compute_dtype)
+        if has_state:
+            out, new_state = apply_fn(p, model_state, *inputs, **kwinputs)
+            if cast_model_outputs is not None:
+                out = _cast_floats(out, cast_model_outputs)
+            return out, new_state
+        out = apply_fn(p, *inputs, **kwinputs)
+        if cast_model_outputs is not None:
+            out = _cast_floats(out, cast_model_outputs)
+        return out
+
+    return amp_apply
+
+
 def scaled_value_and_grad(
-    loss_fn: Callable, scaler: LossScaler, *, has_aux: bool = False, impl=None
+    loss_fn: Callable, scaler: LossScaler, *, has_aux: bool = False, impl=None,
+    reduce_grads: Optional[Callable] = None,
 ):
     """The functional ``amp.scale_loss`` (ref: apex/amp/handle.py:17-158).
 
@@ -209,6 +241,13 @@ def scaled_value_and_grad(
     is detected in the fused unscale kernel, and the scaler state advances —
     the context manager's enter/exit collapsed into one jittable call. Thread
     ``found_inf`` into ``optimizer.step`` for the skip-step.
+
+    ``reduce_grads`` (e.g. ``DistributedDataParallel.reduce``) runs on the
+    still-scaled low-precision grads BEFORE unscale — the reference's hot-loop
+    order (NCCL allreduce of scaled fp16 grads during backward, fused unscale
+    on exit, apex/parallel/distributed.py:352-409 + amp/scaler.py:114-126) —
+    so overflow detection sees the reduced grads and every rank takes the same
+    skip-step decision.
     """
 
     def wrapped(params, scaler_state, *args, **kw):
@@ -218,6 +257,8 @@ def scaled_value_and_grad(
             return scaler.scale_loss(loss, scaler_state), (loss, aux)
 
         grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(params)
+        if reduce_grads is not None:
+            grads = reduce_grads(grads)
         grads, found_inf = scaler.unscale(grads, scaler_state, impl=impl)
         new_state = scaler.update(scaler_state, found_inf)
         if has_aux:

@@ -1,0 +1,25 @@
+"""Model zoo — the reference ships its flagship models via torchvision +
+in-repo testing harnesses (examples/imagenet/main_amp.py:135,
+apex/transformer/testing/standalone_gpt.py); here they are first-class."""
+
+from beforeholiday_tpu.models import resnet
+from beforeholiday_tpu.models.resnet import (
+    CONFIGS,
+    ResNetConfig,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)
+
+__all__ = [
+    "resnet",
+    "CONFIGS",
+    "ResNetConfig",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+]

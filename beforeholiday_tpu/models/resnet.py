@@ -1,0 +1,323 @@
+"""TPU-native ResNet family — the reference examples' flagship model.
+
+The reference trains torchvision ResNets through apex amp + DDP
+(ref: examples/imagenet/main_amp.py:135-174, tests/L1/common/main_amp.py); the
+model itself lives in torchvision, so this file re-derives the architecture
+(He et al. 2015) TPU-first rather than porting code:
+
+* **NHWC (channels-last) everywhere** — the TPU convolution layout; the
+  reference exposes it as an opt-in ``--channels-last`` flag
+  (main_amp.py:93,130-133), here it is the only layout.
+* **Functional**: ``init`` returns a params pytree + a BN-state pytree
+  (running stats, always fp32 — the reference's ``keep_batchnorm_fp32``
+  applies to BN buffers too); ``forward`` is pure and jittable.
+* **SyncBN built in**: every BatchNorm is ``parallel.sync_batch_norm``; pass
+  ``axis_name="data"`` inside shard_map and the model IS the reference's
+  ``convert_syncbn_model``'d network (main_amp.py:142-145) — no module
+  rewrite needed.
+* Param names follow torch's (``conv1``, ``bn1``, ``layer1.0.downsample``),
+  so amp's ``keep_batchnorm_fp32`` name heuristic and torch-state-dict
+  import both work.
+
+Init matches torch defaults: Kaiming-normal fan_out for convs, BN scale 1 /
+bias 0, Linear uniform(-1/sqrt(fan_in), +1/sqrt(fan_in)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.parallel.sync_batch_norm import (
+    BatchNormParams,
+    BatchNormState,
+    init_batch_norm,
+    sync_batch_norm,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    """Architecture knobs. Presets below match torchvision's resnet18..152."""
+
+    block: str  # "basic" | "bottleneck"
+    layers: Tuple[int, ...]  # blocks per stage
+    width: int = 64  # stem output channels
+    num_classes: int = 1000
+    stem_kernel: int = 7
+    stem_stride: int = 2
+    stem_pool: bool = True  # 3x3/2 maxpool after the stem
+    zero_init_residual: bool = False  # torchvision flag: last-BN scale = 0
+
+    @property
+    def expansion(self) -> int:
+        return 1 if self.block == "basic" else 4
+
+    def stage_channels(self) -> Tuple[int, ...]:
+        return tuple(self.width * (2**i) for i in range(len(self.layers)))
+
+
+def resnet18(**kw) -> ResNetConfig:
+    return ResNetConfig(block="basic", layers=(2, 2, 2, 2), **kw)
+
+
+def resnet34(**kw) -> ResNetConfig:
+    return ResNetConfig(block="basic", layers=(3, 4, 6, 3), **kw)
+
+
+def resnet50(**kw) -> ResNetConfig:
+    return ResNetConfig(block="bottleneck", layers=(3, 4, 6, 3), **kw)
+
+
+def resnet101(**kw) -> ResNetConfig:
+    return ResNetConfig(block="bottleneck", layers=(3, 4, 23, 3), **kw)
+
+
+def resnet152(**kw) -> ResNetConfig:
+    return ResNetConfig(block="bottleneck", layers=(3, 8, 36, 3), **kw)
+
+
+def tiny_test_config(num_classes: int = 10) -> ResNetConfig:
+    """Small net for CPU-mesh tests: 16x16 inputs, two stages."""
+    return ResNetConfig(
+        block="basic", layers=(1, 1), width=8, num_classes=num_classes,
+        stem_kernel=3, stem_stride=1, stem_pool=False,
+    )
+
+
+CONFIGS = {
+    "resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
+    "resnet101": resnet101, "resnet152": resnet152,
+}
+
+
+# ---------------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------------
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    """Kaiming normal, fan_out, relu gain — torch's resnet conv init."""
+    std = math.sqrt(2.0 / (kh * kw * cout))
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * std
+
+
+def _bn(c, zero_scale=False):
+    params, state = init_batch_norm(c)
+    if zero_scale:
+        params = BatchNormParams(jnp.zeros_like(params.scale), params.bias)
+    return params, state
+
+
+def _block_init(key, cfg: ResNetConfig, cin: int, cout: int, stride: int):
+    """One residual block. Returns (params, bn_state)."""
+    p: Dict[str, Any] = {}
+    s: Dict[str, Any] = {}
+    zir = cfg.zero_init_residual
+    if cfg.block == "basic":
+        k1, k2, k3 = jax.random.split(key, 3)
+        p["conv1"] = _conv_init(k1, 3, 3, cin, cout)
+        p["bn1"], s["bn1"] = _bn(cout)
+        p["conv2"] = _conv_init(k2, 3, 3, cout, cout)
+        p["bn2"], s["bn2"] = _bn(cout, zero_scale=zir)
+        out_c = cout
+    else:
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        mid = cout
+        out_c = cout * 4
+        p["conv1"] = _conv_init(k1, 1, 1, cin, mid)
+        p["bn1"], s["bn1"] = _bn(mid)
+        p["conv2"] = _conv_init(k2, 3, 3, mid, mid)
+        p["bn2"], s["bn2"] = _bn(mid)
+        p["conv3"] = _conv_init(k3, 1, 1, mid, out_c)
+        p["bn3"], s["bn3"] = _bn(out_c, zero_scale=zir)
+        k3 = k4
+    if stride != 1 or cin != out_c:
+        p["downsample_conv"] = _conv_init(k3, 1, 1, cin, out_c)
+        p["downsample_bn"], s["downsample_bn"] = _bn(out_c)
+    return p, s
+
+
+def init(key: jax.Array, cfg: ResNetConfig, in_channels: int = 3):
+    """Returns (params, bn_state) pytrees."""
+    n_stages = len(cfg.layers)
+    keys = jax.random.split(key, 2 + n_stages)
+    p: Dict[str, Any] = {}
+    s: Dict[str, Any] = {}
+    p["conv1"] = _conv_init(
+        keys[0], cfg.stem_kernel, cfg.stem_kernel, in_channels, cfg.width
+    )
+    p["bn1"], s["bn1"] = _bn(cfg.width)
+
+    cin = cfg.width
+    for i, (n_blocks, cout) in enumerate(zip(cfg.layers, cfg.stage_channels())):
+        stage_p, stage_s = {}, {}
+        bkeys = jax.random.split(keys[2 + i], n_blocks)
+        for j in range(n_blocks):
+            stride = 2 if (j == 0 and i > 0) else 1
+            stage_p[str(j)], stage_s[str(j)] = _block_init(
+                bkeys[j], cfg, cin, cout, stride
+            )
+            cin = cout * cfg.expansion
+        p[f"layer{i + 1}"] = stage_p
+        s[f"layer{i + 1}"] = stage_s
+
+    fan_in = cin
+    bound = 1.0 / math.sqrt(fan_in)
+    kw, kb = jax.random.split(keys[1])
+    p["fc"] = {
+        "w": jax.random.uniform(kw, (fan_in, cfg.num_classes), jnp.float32, -bound, bound),
+        "b": jax.random.uniform(kb, (cfg.num_classes,), jnp.float32, -bound, bound),
+    }
+    return p, s
+
+
+# ---------------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------------
+
+
+def _conv(x, w, stride=1):
+    """NHWC conv with torch's symmetric padding ((k-1)//2)."""
+    kh, kw = w.shape[0], w.shape[1]
+    pad = [((kh - 1) // 2, (kh - 1) // 2), ((kw - 1) // 2, (kw - 1) // 2)]
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(stride, stride), padding=pad,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _maxpool_3x3_s2(x):
+    return jax.lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        jax.lax.max, window_dimensions=(1, 3, 3, 1), window_strides=(1, 2, 2, 1),
+        padding=((0, 0), (1, 1), (1, 1), (0, 0)),
+    )
+
+
+def _apply_bn(x, bp, bs, training, momentum, axis_name, fuse_relu=False):
+    return sync_batch_norm(
+        x, bp, bs, training=training, momentum=momentum, axis_name=axis_name,
+        channel_last=True, fuse_relu=fuse_relu,
+    )
+
+
+def _block_forward(cfg, p, s, x, stride, *, training, momentum, axis_name):
+    new_s: Dict[str, Any] = {}
+    identity = x
+    if cfg.block == "basic":
+        y = _conv(x, p["conv1"], stride)
+        y, new_s["bn1"] = _apply_bn(
+            y, p["bn1"], s["bn1"], training, momentum, axis_name, fuse_relu=True
+        )
+        y = _conv(y, p["conv2"], 1)
+        y, new_s["bn2"] = _apply_bn(y, p["bn2"], s["bn2"], training, momentum, axis_name)
+    else:
+        y = _conv(x, p["conv1"], 1)
+        y, new_s["bn1"] = _apply_bn(
+            y, p["bn1"], s["bn1"], training, momentum, axis_name, fuse_relu=True
+        )
+        y = _conv(y, p["conv2"], stride)
+        y, new_s["bn2"] = _apply_bn(
+            y, p["bn2"], s["bn2"], training, momentum, axis_name, fuse_relu=True
+        )
+        y = _conv(y, p["conv3"], 1)
+        y, new_s["bn3"] = _apply_bn(y, p["bn3"], s["bn3"], training, momentum, axis_name)
+    if "downsample_conv" in p:
+        identity = _conv(x, p["downsample_conv"], stride)
+        identity, new_s["downsample_bn"] = _apply_bn(
+            identity, p["downsample_bn"], s["downsample_bn"], training, momentum, axis_name
+        )
+    return jax.nn.relu(y + identity), new_s
+
+
+def forward(
+    params: Any,
+    bn_state: Any,
+    x: jax.Array,
+    cfg: ResNetConfig,
+    *,
+    training: bool = True,
+    momentum: float = 0.1,
+    axis_name: Optional[str] = None,
+) -> Tuple[jax.Array, Any]:
+    """x: (N, H, W, C) NHWC. Returns (logits fp32-or-x.dtype, new_bn_state).
+
+    ``axis_name`` turns every BN into SyncBN over that mesh axis (the
+    reference's --sync_bn, examples/imagenet/main_amp.py:85-86,142-145).
+    """
+    new_s: Dict[str, Any] = {}
+    y = _conv(x, params["conv1"], cfg.stem_stride)
+    y, new_s["bn1"] = _apply_bn(
+        y, params["bn1"], bn_state["bn1"], training, momentum, axis_name, fuse_relu=True
+    )
+    if cfg.stem_pool:
+        y = _maxpool_3x3_s2(y)
+
+    for i in range(len(cfg.layers)):
+        name = f"layer{i + 1}"
+        stage_new = {}
+        for j in range(cfg.layers[i]):
+            stride = 2 if (j == 0 and i > 0) else 1
+            y, stage_new[str(j)] = _block_forward(
+                cfg, params[name][str(j)], bn_state[name][str(j)], y, stride,
+                training=training, momentum=momentum, axis_name=axis_name,
+            )
+        new_s[name] = stage_new
+
+    y = jnp.mean(y, axis=(1, 2))  # global average pool
+    logits = y @ params["fc"]["w"].astype(y.dtype) + params["fc"]["b"].astype(y.dtype)
+    return logits, new_s
+
+
+# ---------------------------------------------------------------------------------
+# torch interop — load torchvision-style state dicts (for parity tests / users
+# migrating checkpoints)
+# ---------------------------------------------------------------------------------
+
+
+def from_torch_state_dict(cfg: ResNetConfig, sd: Dict[str, Any]):
+    """Map a torchvision resnet ``state_dict()`` (tensors or ndarrays) to
+    (params, bn_state). Conv weights (O,I,H,W) -> (H,W,I,O); fc (O,I) -> (I,O)."""
+
+    def arr(t):
+        # copy=True: torch state_dicts share storage with the live module, and
+        # jnp.asarray may zero-copy-alias host memory — later in-place updates
+        # (BN running stats) would silently mutate our arrays
+        return jnp.array(np_of(t), jnp.float32, copy=True)
+
+    def np_of(t):
+        return t.detach().cpu().numpy() if hasattr(t, "detach") else t
+
+    def conv_w(name):
+        return jnp.transpose(arr(sd[name + ".weight"]), (2, 3, 1, 0))
+
+    def bn(name):
+        return (
+            BatchNormParams(arr(sd[name + ".weight"]), arr(sd[name + ".bias"])),
+            BatchNormState(arr(sd[name + ".running_mean"]), arr(sd[name + ".running_var"])),
+        )
+
+    p: Dict[str, Any] = {"conv1": conv_w("conv1")}
+    s: Dict[str, Any] = {}
+    p["bn1"], s["bn1"] = bn("bn1")
+    n_convs = 2 if cfg.block == "basic" else 3
+    for i in range(len(cfg.layers)):
+        lp, ls = {}, {}
+        for j in range(cfg.layers[i]):
+            bp, bs = {}, {}
+            base = f"layer{i + 1}.{j}"
+            for c in range(1, n_convs + 1):
+                bp[f"conv{c}"] = conv_w(f"{base}.conv{c}")
+                bp[f"bn{c}"], bs[f"bn{c}"] = bn(f"{base}.bn{c}")
+            if f"{base}.downsample.0.weight" in sd:
+                bp["downsample_conv"] = conv_w(f"{base}.downsample.0")
+                bp["downsample_bn"], bs["downsample_bn"] = bn(f"{base}.downsample.1")
+            lp[str(j)], ls[str(j)] = bp, bs
+        p[f"layer{i + 1}"], s[f"layer{i + 1}"] = lp, ls
+    p["fc"] = {"w": jnp.transpose(arr(sd["fc.weight"]), (1, 0)), "b": arr(sd["fc.bias"])}
+    return p, s

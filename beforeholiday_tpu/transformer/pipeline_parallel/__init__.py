@@ -7,6 +7,8 @@ from beforeholiday_tpu.transformer.pipeline_parallel.microbatches import (  # no
 )
 from beforeholiday_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401
 from beforeholiday_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    PipelineGrads,
+    activation_ring_depth,
     forward_backward_no_pipelining,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,

@@ -2,23 +2,39 @@
 (ref: apex/transformer/pipeline_parallel/schedules/).
 
 The reference drives per-rank processes through warmup/steady-1F1B/cooldown
-with explicit NCCL p2p (fwd_bwd_pipelining_without_interleaving.py:228-488).
-TPU-native design: the whole schedule is ONE jitted collective program inside
-``shard_map`` over the ``pipe`` axis — a tick loop (``lax.fori_loop``) where at
-tick ``t``:
+with explicit NCCL p2p (fwd_bwd_pipelining_without_interleaving.py:228-488)
+and an interleaved virtual-chunk variant
+(fwd_bwd_pipelining_with_interleaving.py:26-415). TPU-native design: ONE
+jitted collective program inside ``shard_map`` over the ``pipe`` axis — a
+tick loop (``lax.fori_loop``) over a *logical* pipeline of ``L = V*S`` stages
+(V chunks per device, Megatron's interleaving; V=1 is plain 1F1B). With
+``m = g*S + r`` (microbatches in groups of S) and logical stage
+``l = v*S + s``:
 
-    stage s runs F(m) iff  t == m + s
-    stage s runs B(m) iff  t == m + (2S - 1 - s)
+    device s runs F(m, v) at tick  t = g*V*S + v*S + s + r
+    device s runs B(m, v) at tick  t = V*S + g*V*S + (V-1-v)*S + (S-1-s) + r
 
-which is exactly the 1F1B diamond: the last stage's B(0) fires one tick after
-its F(0), every device alternates F/B in the steady state, and total ticks are
-``M + 2S - 1`` — the 1F1B bubble. Activations ride a +1 ``ppermute`` ring,
-gradients a −1 ring, and idle slots compute on masked garbage that never
-lands (the TPU version of pipeline bubbles — same wasted cycles, no branches).
+Each device executes at most one F and one B slot per tick (the (g, v, r)
+decomposition of ``t - s`` is unique), activations ride a +1 ``ppermute``
+ring and gradients a −1 ring — chunk wraparound (device S-1 chunk v → device
+0 chunk v+1) is the same ring, since the next logical stage always lives on
+``(s+1) mod S``. Idle slots compute on masked garbage that never lands (the
+TPU version of pipeline bubbles — same wasted cycles, no branches). Total
+ticks = ``M*V + V*S + S - 1``; at V=1 this is the familiar ``M + 2S - 1``
+1F1B diamond.
 
-Backward recomputes the stage forward from the saved stage *input* under
-``jax.vjp`` — activation recompute exactly as Megatron runs under
-activation checkpointing; residual memory per stage is the saved inputs.
+Memory: the activation store is a RING of ``2*V*S`` stage inputs —
+independent of M (a microbatch's F→B distance is < 2*V*S ticks, and one F
+per tick makes ``t_F mod 2VS`` collision-free). The backward recomputes the
+stage forward from the saved input under ``jax.vjp`` — activation recompute
+exactly as Megatron runs under activation checkpointing.
+
+Stage shapes are decoupled from the raw input (the reference builds
+embedding/head into its first/last stage modules, schedules/common.py:30
+``build_model``): ``embed_fn`` maps the raw microbatch (e.g. int tokens) to
+the hidden carried by the rings on the first logical stage, ``head_fn`` maps
+the last logical stage's hidden to the loss input. The loss is computed
+ONCE, at the backward slot, via ``value_and_grad``.
 
 Losses follow the reference's convention: each microbatch loss is divided by
 ``num_microbatches`` (schedules/common.py:253 ``forward_step``), so grads
@@ -28,7 +44,7 @@ accumulate to the mean-loss gradient.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -76,6 +92,201 @@ def forward_backward_no_pipelining(
     return loss, grads
 
 
+def activation_ring_depth(V: int, S: int) -> int:
+    """Stage-input slots held in flight per device: 2*V*S, INDEPENDENT of the
+    number of microbatches (a microbatch's F→B tick distance is < 2*V*S and
+    one F fires per tick, so ``t_F mod 2VS`` slots never collide)."""
+    return 2 * V * S
+
+
+class PipelineGrads(NamedTuple):
+    """Gradients from a pipelined run with embed/head stages."""
+
+    stage: Any
+    embed: Any  # None when no embed_fn
+    head: Any  # None when no head_fn
+
+
+def _acc_tree(acc, valid, delta):
+    return jax.tree.map(
+        lambda a, d: a + jnp.where(valid, d, 0.0).astype(a.dtype), acc, delta
+    )
+
+
+def _pipelined_fwd_bwd(
+    stage_fn, loss_fn, chunk_params, inputs, targets, *, V, axis_name,
+    embed_fn=None, embed_params=None, head_fn=None, head_params=None,
+):
+    """The collective tick-loop engine (see module docstring).
+
+    ``chunk_params``: this device's V chunk slices, each leaf (V, ...);
+    chunk v on device s is logical stage v*S + s.
+    """
+    S = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = inputs.shape[0]
+    # S (axis_size) is static inside shard_map, so the tick equations trace.
+    # M % S == 0 is the reference's interleaving contract
+    # (fwd_bwd_pipelining_with_interleaving.py asserts it); V=1 has no
+    # grouping constraint.
+    if V > 1 and M % S != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) divisible by "
+            f"pipeline size ({S}), as the reference asserts"
+        )
+    total_ticks = M * V + V * S + S - 1 if V > 1 else M + 2 * S - 1
+    ring_depth = activation_ring_depth(V, S)
+
+    is_first_dev = rank == 0
+    is_last_dev = rank == S - 1
+
+    def chunk_of(v):
+        return jax.tree.map(lambda leaf: leaf[v], chunk_params)
+
+    def run_embed(ep, raw):
+        return embed_fn(ep, raw) if embed_fn is not None else raw
+
+    def run_head(hp, h):
+        return head_fn(hp, h) if head_fn is not None else h
+
+    # hidden shape carried by the rings
+    if embed_fn is not None:
+        hidden_aval = jax.eval_shape(run_embed, embed_params, inputs[0])
+        hidden_shape, hidden_dtype = hidden_aval.shape, hidden_aval.dtype
+    else:
+        hidden_shape, hidden_dtype = inputs.shape[1:], inputs.dtype
+
+    def decompose_f(t):
+        """F slot: (valid, m, v) from tick t on this device."""
+        u = t - rank
+        r = jnp.where(u >= 0, u % S, 0)
+        q = jnp.where(u >= 0, u // S, 0)  # = g*V + v
+        v = q % V
+        g = q // V
+        m = g * S + r
+        valid = (u >= 0) & (m < M)
+        return valid, jnp.clip(m, 0, M - 1), v, g * V * S + v * S + rank + r
+
+    def decompose_b(t):
+        """B slot: (valid, m, v, t_F) from tick t on this device."""
+        u = t - V * S - (S - 1 - rank)
+        r = jnp.where(u >= 0, u % S, 0)
+        q = jnp.where(u >= 0, u // S, 0)  # = g*V + (V-1-v)
+        v = (V - 1) - (q % V)
+        g = q // V
+        m = g * S + r
+        valid = (u >= 0) & (m < M)
+        t_f = g * V * S + v * S + rank + r
+        return valid, jnp.clip(m, 0, M - 1), v, t_f
+
+    zeros_stage_g = jax.tree.map(jnp.zeros_like, chunk_params)
+    zeros_embed_g = (
+        jax.tree.map(jnp.zeros_like, embed_params) if embed_fn is not None else None
+    )
+    zeros_head_g = (
+        jax.tree.map(jnp.zeros_like, head_params) if head_fn is not None else None
+    )
+
+    def tick(t, carry):
+        act_store, fwd_reg, bwd_reg, g_stage, g_embed, g_head, loss_acc = carry
+
+        # ---- forward slot ---------------------------------------------------------
+        f_valid, m_f, v_f, tf_f = decompose_f(t)
+        sp_f = chunk_of(v_f)
+        is_first_logical = is_first_dev & (v_f == 0)
+        x_raw = inputs[m_f]
+        x_embedded = run_embed(embed_params, x_raw)
+        x_in = jnp.where(is_first_logical, x_embedded, fwd_reg).astype(hidden_dtype)
+        y = stage_fn(sp_f, x_in)
+        slot_f = tf_f % ring_depth
+        act_store = jnp.where(
+            f_valid,
+            jax.lax.dynamic_update_index_in_dim(act_store, x_in, slot_f, 0),
+            act_store,
+        )
+
+        # ---- backward slot --------------------------------------------------------
+        b_valid, m_b, v_b, tf_b = decompose_b(t)
+        sp_b = chunk_of(v_b)
+        slot_b = tf_b % ring_depth
+        x_saved = jax.lax.dynamic_index_in_dim(act_store, slot_b, 0, keepdims=False)
+        is_last_logical = is_last_dev & (v_b == V - 1)
+        is_first_logical_b = is_first_dev & (v_b == 0)
+        tgt_b = targets[m_b]
+
+        def last_branch():
+            """value_and_grad through stage+head+loss: the loss is computed
+            exactly once per microbatch, here."""
+
+            def full(sp, hp, x):
+                out = run_head(hp, stage_fn(sp, x))
+                return loss_fn(out, tgt_b) / M
+
+            if head_fn is not None:
+                mb_loss, (dsp, dhp, dx) = jax.value_and_grad(full, argnums=(0, 1, 2))(
+                    sp_b, head_params, x_saved
+                )
+                return mb_loss, dsp, dhp, dx
+            mb_loss, (dsp, dx) = jax.value_and_grad(
+                lambda sp, x: full(sp, None, x), argnums=(0, 1)
+            )(sp_b, x_saved)
+            return mb_loss, dsp, zeros_head_g, dx
+
+        def inner_branch():
+            _, vjp = jax.vjp(lambda sp, x: stage_fn(sp, x), sp_b, x_saved)
+            dsp, dx = vjp(bwd_reg.astype(hidden_dtype))
+            return jnp.float32(0.0), dsp, zeros_head_g, dx
+
+        mb_loss, dsp, dhp, dx = jax.lax.cond(is_last_logical, last_branch, inner_branch)
+
+        loss_acc = loss_acc + jnp.where(b_valid & is_last_logical, mb_loss, 0.0)
+        # scatter-accumulate the chunk's grads into its row of the V-stacked acc
+        g_stage = jax.tree.map(
+            lambda acc, d: jnp.where(
+                b_valid,
+                jax.lax.dynamic_update_index_in_dim(
+                    acc, acc[v_b] + d.astype(acc.dtype), v_b, 0
+                ),
+                acc,
+            ),
+            g_stage,
+            dsp,
+        )
+        if head_fn is not None:
+            g_head = _acc_tree(g_head, b_valid & is_last_logical, dhp)
+        if embed_fn is not None:
+            # pull dx through the embedding on the first logical stage
+            _, vjp_e = jax.vjp(lambda ep: run_embed(ep, inputs[m_b]), embed_params)
+            (dep,) = vjp_e(jnp.where(is_first_logical_b, dx, 0.0).astype(hidden_dtype))
+            g_embed = _acc_tree(g_embed, b_valid & is_first_logical_b, dep)
+
+        # ---- rings ---------------------------------------------------------------
+        fwd_reg, bwd_reg = p2p_communication.send_forward_recv_backward(
+            jnp.where(f_valid, y, 0.0).astype(hidden_dtype),
+            jnp.where(b_valid, dx, 0.0).astype(hidden_dtype),
+            axis_name=axis_name,
+        )
+        return act_store, fwd_reg, bwd_reg, g_stage, g_embed, g_head, loss_acc
+
+    act_store0 = jnp.zeros((ring_depth,) + hidden_shape, hidden_dtype)
+    fwd_reg0 = jnp.zeros(hidden_shape, hidden_dtype)
+    bwd_reg0 = jnp.zeros(hidden_shape, hidden_dtype)
+    (_, _, _, g_stage, g_embed, g_head, loss) = jax.lax.fori_loop(
+        0, total_ticks, tick,
+        (act_store0, fwd_reg0, bwd_reg0, zeros_stage_g, zeros_embed_g,
+         zeros_head_g, jnp.float32(0.0)),
+    )
+    # every stage reports the mean loss (ref: losses_reduced broadcast); embed/
+    # head grads live on their stage only and are zero elsewhere, so the same
+    # psum makes them whole everywhere
+    loss = jax.lax.psum(loss, axis_name)
+    if embed_fn is not None:
+        g_embed = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_embed)
+    if head_fn is not None:
+        g_head = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_head)
+    return loss, g_stage, g_embed, g_head
+
+
 def forward_backward_pipelining_without_interleaving(
     stage_fn: Callable,
     loss_fn: Callable,
@@ -84,105 +295,67 @@ def forward_backward_pipelining_without_interleaving(
     targets: jax.Array,
     *,
     axis_name: str = PIPE_AXIS,
+    embed_fn: Optional[Callable] = None,
+    embed_params: Any = None,
+    head_fn: Optional[Callable] = None,
+    head_params: Any = None,
 ):
     """1F1B schedule (ref: fwd_bwd_pipelining_without_interleaving.py:228-488).
 
     Runs INSIDE shard_map with the pipe axis bound. ``params`` is this stage's
-    slice; ``inputs`` (M, *micro) feeds stage 0; ``targets`` (M, *tgt) are
-    consumed by the last stage. Activations between stages must all share
-    ``inputs``'s per-microbatch shape/dtype (the reference's fixed
-    ``tensor_shape`` contract, :241). Returns (mean loss, this stage's grads);
-    loss is valid on every stage (psum'd), as the reference broadcasts it.
+    slice; ``inputs`` (M, *micro) feeds the first stage (through ``embed_fn``
+    if given); ``targets`` (M, *tgt) are consumed by the last stage (through
+    ``head_fn``). Returns ``(mean loss, grads)`` where grads is this stage's
+    pytree when no embed/head is given (backward compatible), else a
+    ``PipelineGrads(stage, embed, head)``. Loss is valid on every stage
+    (psum'd), as the reference broadcasts it.
     """
-    S = jax.lax.axis_size(axis_name)
-    rank = jax.lax.axis_index(axis_name)
-    M = inputs.shape[0]
-    micro_shape = inputs.shape[1:]
-    # last backward: B(M-1) on stage 0 at t = (M-1) + (2S-1) → inclusive range
-    total_ticks = M + 2 * S - 1
-
-    is_first = rank == 0
-    is_last = rank == S - 1
-
-    def fwd_only(p, x):
-        return stage_fn(p, x)
-
-    def last_stage_loss(p, x, tgt):
-        return loss_fn(stage_fn(p, x), tgt) / M
-
-    zeros_g = jax.tree.map(jnp.zeros_like, params)
-
-    def tick(t, carry):
-        act_store, fwd_reg, bwd_reg, gacc, loss_acc = carry
-
-        # ---- forward slot: F(m) at t == m + rank --------------------------------
-        m_f = t - rank
-        f_valid = (m_f >= 0) & (m_f < M)
-        m_f_c = jnp.clip(m_f, 0, M - 1)
-        x_in = jnp.where(is_first, inputs[m_f_c], fwd_reg)
-        y = stage_fn(params, x_in)
-        # stash the stage input for the backward recompute
-        act_store = jnp.where(
-            f_valid,
-            jax.lax.dynamic_update_index_in_dim(act_store, x_in, m_f_c, 0),
-            act_store,
-        )
-        # last stage: bank the microbatch loss at forward time from the already
-        # computed y (ref: the loss reduction in forward_step)
-        mb_loss = loss_fn(y, targets[m_f_c]) / M
-        loss_acc = loss_acc + jnp.where(f_valid & is_last, mb_loss, 0.0)
-
-        # ---- backward slot: B(m) at t == m + (2S - 1 - rank) --------------------
-        m_b = t - (2 * S - 1 - rank)
-        b_valid = (m_b >= 0) & (m_b < M)
-        m_b_c = jnp.clip(m_b, 0, M - 1)
-        x_saved = jax.lax.dynamic_index_in_dim(act_store, m_b_c, 0, keepdims=False)
-
-        # recompute-vjp of this stage for microbatch m_b
-        def stage_and_dx(dy):
-            _, vjp = jax.vjp(fwd_only, params, x_saved)
-            return vjp(dy)
-
-        def last_stage_grads():
-            return jax.grad(last_stage_loss, argnums=(0, 1))(
-                params, x_saved, targets[m_b_c]
-            )
-
-        def inner_grads():
-            return stage_and_dx(bwd_reg)
-
-        dp, dx = jax.lax.cond(is_last, last_stage_grads, inner_grads)
-
-        gacc = jax.tree.map(
-            lambda a, d: a + jnp.where(b_valid, d, 0.0).astype(a.dtype), gacc, dp
-        )
-
-        # ---- rings: the steady-state 1F1B send/recv pair ------------------------
-        fwd_reg, bwd_reg = p2p_communication.send_forward_recv_backward(
-            y, jnp.where(b_valid, dx, 0.0), axis_name=axis_name
-        )
-        return act_store, fwd_reg, bwd_reg, gacc, loss_acc
-
-    act_store0 = jnp.zeros((M,) + micro_shape, inputs.dtype)
-    fwd_reg0 = jnp.zeros(micro_shape, inputs.dtype)
-    bwd_reg0 = jnp.zeros(micro_shape, inputs.dtype)
-    act_store, _, _, grads, loss = jax.lax.fori_loop(
-        0,
-        total_ticks,
-        tick,
-        (act_store0, fwd_reg0, bwd_reg0, zeros_g, jnp.float32(0.0)),
+    chunked = jax.tree.map(lambda leaf: leaf[None], params)
+    loss, g_stage, g_embed, g_head = _pipelined_fwd_bwd(
+        stage_fn, loss_fn, chunked, inputs, targets, V=1, axis_name=axis_name,
+        embed_fn=embed_fn, embed_params=embed_params,
+        head_fn=head_fn, head_params=head_params,
     )
-    # every stage reports the mean loss (ref: losses_reduced broadcast)
-    loss = jax.lax.psum(loss, axis_name)
-    return loss, grads
+    g_stage = jax.tree.map(lambda g: g[0], g_stage)
+    if embed_fn is None and head_fn is None:
+        return loss, g_stage
+    return loss, PipelineGrads(g_stage, g_embed, g_head)
 
 
-def forward_backward_pipelining_with_interleaving(*args, **kw):
+def forward_backward_pipelining_with_interleaving(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    chunk_params: Any,
+    inputs: jax.Array,
+    targets: jax.Array,
+    *,
+    virtual_pipeline_model_parallel_size: int,
+    axis_name: str = PIPE_AXIS,
+    embed_fn: Optional[Callable] = None,
+    embed_params: Any = None,
+    head_fn: Optional[Callable] = None,
+    head_params: Any = None,
+):
     """Interleaved virtual-pipeline schedule
-    (ref: fwd_bwd_pipelining_with_interleaving.py:26-415) — lands with the
-    virtual-chunk engine; until then the non-interleaved 1F1B schedule is the
-    supported path."""
-    raise NotImplementedError(
-        "interleaved virtual-pipeline schedule is not implemented yet; use "
-        "forward_backward_pipelining_without_interleaving"
+    (ref: fwd_bwd_pipelining_with_interleaving.py:26-415).
+
+    ``chunk_params`` leaves lead with the V (virtual chunk) dim: chunk v on
+    device s is logical stage ``v*S + s`` — Megatron's chunk placement. The
+    number of microbatches must be a multiple of the pipe size (the
+    reference's assert). Returns ``(loss, grads)`` with grads leading with V
+    (or ``PipelineGrads`` when embed/head are given).
+    """
+    V = virtual_pipeline_model_parallel_size
+    leaves = jax.tree.leaves(chunk_params)
+    if leaves and any(leaf.shape[0] != V for leaf in leaves):
+        raise ValueError(
+            f"chunk_params leaves must lead with V={V}, got {leaves[0].shape}"
+        )
+    loss, g_stage, g_embed, g_head = _pipelined_fwd_bwd(
+        stage_fn, loss_fn, chunk_params, inputs, targets, V=V, axis_name=axis_name,
+        embed_fn=embed_fn, embed_params=embed_params,
+        head_fn=head_fn, head_params=head_params,
     )
+    if embed_fn is None and head_fn is None:
+        return loss, g_stage
+    return loss, PipelineGrads(g_stage, g_embed, g_head)

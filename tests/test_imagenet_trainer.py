@@ -1,0 +1,130 @@
+"""North-star trainer contracts (BASELINE.md configs 1-3): amp opt levels
+don't change the model, and DP training equals single-device training —
+the reference's L1 cross-product + DDP oracles
+(tests/L1/common/run_test.sh, tests/distributed/DDP)."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "examples", "imagenet")
+)
+import main_amp  # noqa: E402
+
+from beforeholiday_tpu.models import resnet  # noqa: E402
+
+
+def _batches(n, batch=16, hw=16, classes=10, seed=7):
+    rng = np.random.RandomState(seed)
+    return [
+        (rng.randint(0, 256, (batch, hw, hw, 3), np.uint8),
+         rng.randint(0, classes, (batch,), np.int64))
+        for _ in range(n)
+    ]
+
+
+def _run(trainer, batches, lr=0.05):
+    losses = []
+    for images, labels in batches:
+        i, l = trainer.shard_batch(images, labels)
+        m = trainer.step(i, l, lr)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def _single_device_trainer(**kw):
+    return main_amp.build_trainer(
+        cfg=resnet.tiny_test_config(), global_batch=16, num_classes=10,
+        distributed=False, devices=jax.devices()[:1], **kw,
+    )
+
+
+class TestOptLevelParity:
+    """O-levels agree with the O0 baseline on short deterministic runs
+    (ref: tests/L1/common/compare.py:34-40 --use_baseline)."""
+
+    @pytest.fixture(scope="class")
+    def o0_losses(self):
+        tr = _single_device_trainer(opt_level="O0")
+        return _run(tr, _batches(4))
+
+    @pytest.mark.parametrize("opt_level,tol", [
+        ("O1", 2e-2), ("O2", 2e-2), ("O4", 4e-2), ("O5", 4e-2),
+    ])
+    def test_matches_o0(self, o0_losses, opt_level, tol):
+        tr = _single_device_trainer(opt_level=opt_level)
+        losses = _run(tr, _batches(4))
+        np.testing.assert_allclose(losses, o0_losses, rtol=tol, atol=tol)
+
+    def test_o2_keeps_bn_fp32_and_casts_convs(self):
+        tr = _single_device_trainer(opt_level="O2")
+        p = tr.params
+        assert p["conv1"].dtype == jnp.float16
+        assert p["bn1"].scale.dtype == jnp.float32
+        assert p["layer2"]["0"]["downsample_bn"].bias.dtype == jnp.float32
+        assert p["fc"]["w"].dtype == jnp.float16
+
+    def test_o5_master_weights_wrap(self):
+        tr = _single_device_trainer(opt_level="O5")
+        assert "master" in tr.opt_state
+        masters = tr.opt_state["master"]
+        assert masters["conv1"].dtype == jnp.float32
+        assert tr.params["conv1"].dtype == jnp.bfloat16
+
+    def test_dynamic_scaler_skips_do_not_poison_params(self):
+        """Force an overflow step: params must be unchanged by it
+        (ref: apex/amp/handle.py:127-154 skip-step)."""
+        tr = _single_device_trainer(opt_level="O2", loss_scale=2.0**24)
+        images, labels = _batches(1)[0]
+        i, l = tr.shard_batch(images, labels)
+        before = jax.tree.map(lambda x: np.asarray(x).copy(), tr.params)
+        m = tr.step(i, l, 0.05)
+        # fp16 grads at scale 2^24 overflow
+        assert bool(m["found_inf"])
+        after = tr.params
+        for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before)):
+            np.testing.assert_array_equal(np.asarray(a), b)
+
+
+class TestDistributedParity:
+    def test_ddp_syncbn_matches_single_device(self, devices8):
+        """8-way DP + SyncBN over the sharded batch == single device on the
+        full batch (the DDP semantics oracle)."""
+        batches = _batches(3)
+        tr1 = _single_device_trainer(opt_level="O0", sync_bn=False)
+        l1 = _run(tr1, batches)
+        tr8 = main_amp.build_trainer(
+            cfg=resnet.tiny_test_config(), global_batch=16, num_classes=10,
+            distributed=True, devices=devices8, opt_level="O0", sync_bn=True,
+        )
+        l8 = _run(tr8, batches)
+        np.testing.assert_allclose(l8, l1, rtol=1e-4, atol=1e-4)
+        for a, b in zip(jax.tree.leaves(tr8.params), jax.tree.leaves(tr1.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+    def test_ddp_amp_o2_runs_and_converges_direction(self, devices8):
+        """O2 + DDP + SyncBN (north-star config 3) trains: loss drops over
+        synthetic memorization of one repeated batch."""
+        tr = main_amp.build_trainer(
+            cfg=resnet.tiny_test_config(), global_batch=16, num_classes=10,
+            distributed=True, devices=devices8, opt_level="O2", sync_bn=True,
+        )
+        b = _batches(1)
+        losses = _run(tr, b * 6, lr=0.1)
+        assert losses[-1] < losses[0], losses
+
+    def test_eval_step(self, devices8):
+        tr = main_amp.build_trainer(
+            cfg=resnet.tiny_test_config(), global_batch=16, num_classes=10,
+            distributed=True, devices=devices8, opt_level="O5",
+        )
+        images, labels = _batches(1)[0]
+        i, l = tr.shard_batch(images, labels)
+        m = tr.evaluate(i, l)
+        assert np.isfinite(float(m["loss"]))
+        assert 0.0 <= float(m["prec5"]) <= 100.0
