@@ -9,6 +9,7 @@
 * ``softmax`` — the scaled/masked softmax family (4 megatron kernels).
 * ``dense`` — fused dense / GELU-epilogue dense / whole-MLP chains
   (``fused_dense_cuda``, ``mlp_cuda``) — XLA-epilogue-fused by construction.
+* ``attention`` — Pallas flash attention (``fmhalib``, ``fast_multihead_attn``).
 """
 
 from .arena import ArenaSpec, flatten, make_spec, unflatten  # noqa: F401
@@ -40,4 +41,9 @@ from .dense import (  # noqa: F401
     fused_dense_gelu_dense,
     init_mlp_params,
     mlp,
+)
+from .attention import (  # noqa: F401
+    flash_attention,
+    is_flash_available,
+    self_attention,
 )

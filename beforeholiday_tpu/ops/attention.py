@@ -1,0 +1,458 @@
+"""Fused multi-head attention — a Pallas flash attention for TPU.
+
+TPU-native counterpart of the reference's two fused-attention extensions:
+
+* ``apex.contrib.fmha`` (ref: apex/contrib/fmha/fmha.py:33-60) — CUTLASS
+  fused MHA, SM80-only, seq <= 512, variable-length via cu_seqlens;
+* ``apex.contrib.multihead_attn`` (ref:
+  apex/contrib/multihead_attn/self_multihead_attn.py:22) — fused
+  self/enc-dec attention kernels.
+
+Both exist to avoid materializing the (B*H, S, S) score tensor. The TPU
+design is a single flash-attention kernel family instead of per-module CUDA:
+the forward streams K/V blocks through VMEM with an online softmax
+(running max ``m``, running sum ``l``), the backward recomputes block scores
+from the saved (q, k, v, lse) — the same rematerialization trade the
+reference's backward kernels make, shaped for the MXU: every inner op is a
+(BQ, D) x (D, BK)-style matmul, fp32 accumulation.
+
+Variable-length batches are expressed as per-sequence key lengths
+(``kv_lens``) rather than the reference's packed cu_seqlens: on TPU the
+padded-dense layout keeps shapes static for XLA while the kernel masks
+``k >= len`` in-block, which is the moral equivalent of fmha's seqlen
+handling without the gather/scatter traffic.
+
+Dispatch follows the repo-wide policy (`_pallas_util.resolve_impl`): Pallas
+on single-device TPU or inside fully-manual shard_map, jnp (unfused but
+GSPMD-partitionable) elsewhere; plus a shape gate like the reference's
+``is_kernel_available`` (fused_softmax.py:164).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from beforeholiday_tpu.ops._pallas_util import (
+    interpret_default as _interpret_default,
+    resolve_impl as _resolve_impl,
+)
+
+_NEG = -1e30  # mask fill; large-negative (not -inf) keeps exp/max NaN-free
+
+_MIN_BLOCK = 128
+
+
+def _block_size(seq_len: int) -> int:
+    """Largest block (query rows == key cols) that tiles the sequence.
+
+    Bigger blocks amortize per-grid-step overhead (measured ~µs/step on
+    v5e); 512 keeps s (512x512 fp32 = 1 MB) + q/k/v/acc blocks well inside
+    the ~16 MB VMEM budget."""
+    for cand in (512, 256, 128):
+        if seq_len % cand == 0:
+            return cand
+    return _MIN_BLOCK
+
+
+def is_flash_available(seq_len: int, head_dim: int) -> bool:
+    """Shape gate for the Pallas kernel (ref: fused_softmax.py:164
+    ``is_kernel_available`` plays the same role for the softmax kernels).
+
+    Requires the sequence to tile exactly into (BQ, BK) blocks and a head
+    dim that fits VMEM comfortably alongside the accumulators.
+    """
+    return seq_len % _MIN_BLOCK == 0 and 8 <= head_dim <= 512
+
+
+# ---------------------------------------------------------------------------------
+# forward kernel: grid (BH, nq, nk); nk innermost so the VMEM accumulators
+# (acc, m, l) carry across key blocks of one query block
+# ---------------------------------------------------------------------------------
+
+
+def _mask(causal, i, j, lens, shape, bq, bk):
+    """Additive-mask predicate for score block (i, j). True = masked out.
+    ``lens`` is a scalar int32 (this sequence's key length)."""
+    kj = j * bk + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    masked = kj >= lens
+    if causal:
+        qi = i * bq + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        masked |= kj > qi
+    return masked
+
+
+def _fa_fwd_kernel(causal, scale, nk, bq, bk, lens_ref, q_ref, k_ref, v_ref,
+                   o_ref, lse_ref, acc_ref, m_ref, l_ref):
+    b, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    seq_len = lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = (j * bk <= i * bq + (bq - 1)) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _compute():
+        # matmuls keep the input dtype (bf16 on the MXU's native path) with
+        # fp32 accumulation via preferred_element_type — casting up first
+        # would force the slow multi-pass fp32 MXU mode
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        masked = _mask(causal, i, j, seq_len, s.shape, bq, bk)
+        s = jnp.where(masked, _NEG, s)
+        m_prev = m_ref[...]                      # (BQ, 128) lane-replicated
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # explicit zero on masked slots: when a whole row is masked s == m_new
+        # == _NEG and exp(s - m) would be 1, not 0
+        p = jnp.where(masked, 0.0, jnp.exp(s - m_new[:, 0:1]))
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha[:, 0:1] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(j == nk - 1)
+    def _final():
+        l = l_ref[:, 0:1]
+        nonempty = l > 0.0
+        o = jnp.where(nonempty, acc_ref[...] / jnp.where(nonempty, l, 1.0), 0.0)
+        o_ref[0] = o.astype(o_ref.dtype)
+        # lane-replicated (BQ, 128) — the TPU-native layout for per-row
+        # scalars (a (1, BQ) block fails Mosaic's (8, 128) tiling rule)
+        lse_ref[0] = jnp.where(
+            nonempty, m_ref[...] + jnp.log(jnp.where(nonempty, l_ref[...], 1.0)), _NEG
+        )
+
+
+def _fa_fwd_pallas(q, k, v, lens, causal, scale, interpret):
+    BH, S, D = q.shape
+    bq = bk = _block_size(S)
+    nq, nk = S // bq, S // bk
+    # lens rides scalar-prefetch SMEM (a (1,1)-blocked SMEM operand fails
+    # Mosaic's tiling check); index maps receive the scalar ref last
+    qspec = pl.BlockSpec((1, bq, D), lambda b, i, j, lens_ref: (b, i, 0))
+    kspec = pl.BlockSpec((1, bk, D), lambda b, i, j, lens_ref: (b, j, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(BH, nq, nk),
+        in_specs=[qspec, kspec, kspec],
+        out_specs=[
+            qspec,
+            pl.BlockSpec((1, bq, 128), lambda b, i, j, lens_ref: (b, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+    )
+    o, lse = pl.pallas_call(
+        functools.partial(_fa_fwd_kernel, causal, scale, nk, bq, bk),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((BH, S, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lens.astype(jnp.int32), q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------------
+# backward: dq kernel (grid BH, nq, nk) + dkv kernel (grid BH, nk, nq); both
+# recompute block scores from (q, k, lse) — flash-attention rematerialization
+# ---------------------------------------------------------------------------------
+
+
+def _block_p_ds(causal, scale, i, j, lens, q, k, v, do, o, lse, bq, bk):
+    """Shared recompute: probabilities p and score-grad ds for block (i, j).
+    ``lse``: (BQ, 128) lane-replicated; delta_i = rowsum(dO_i * O_i) is
+    recomputed here from the o/do blocks (cheap VPU work vs another HBM
+    residual). Matmuls run in the input dtype with fp32 accumulation."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    masked = _mask(causal, i, j, lens, s.shape, bq, bk)
+    p = jnp.where(masked, 0.0, jnp.exp(jnp.where(masked, _NEG, s) - lse[:, 0:1]))
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+def _fa_dq_kernel(causal, scale, nk, bq, bk, lens_ref, q_ref, k_ref, v_ref,
+                  do_ref, o_ref, lse_ref, dq_ref, dq_acc):
+    b, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = (j * bk <= i * bq + (bq - 1)) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _compute():
+        _, ds = _block_p_ds(
+            causal, scale, i, j, lens_ref[b],
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], o_ref[0], lse_ref[0],
+            bq, bk,
+        )
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _final():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel(causal, scale, nq, bq, bk, lens_ref, q_ref, k_ref, v_ref,
+                   do_ref, o_ref, lse_ref, dk_ref, dv_ref, dk_acc, dv_acc):
+    # k block outer, q block inner
+    b, j, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = (i * bq + (bq - 1) >= j * bk) if causal else (i >= 0)
+
+    @pl.when(live)
+    def _compute():
+        p, ds = _block_p_ds(
+            causal, scale, i, j, lens_ref[b],
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], o_ref[0], lse_ref[0],
+            bq, bk,
+        )
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0],
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0],
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == nq - 1)
+    def _final():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_bwd_pallas(q, k, v, do, o, lse, lens, causal, scale, interpret):
+    BH, S, D = q.shape
+    bq = bk = _block_size(S)
+    nq, nk = S // bq, S // bk
+    lens_i = lens.astype(jnp.int32)
+    qspec_i = pl.BlockSpec((1, bq, D), lambda b, i, j, lens_ref: (b, i, 0))
+    kspec_j = pl.BlockSpec((1, bk, D), lambda b, i, j, lens_ref: (b, j, 0))
+    lse_i = pl.BlockSpec((1, bq, 128), lambda b, i, j, lens_ref: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_fa_dq_kernel, causal, scale, nk, bq, bk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nq, nk),
+            in_specs=[qspec_i, kspec_j, kspec_j, qspec_i, qspec_i, lse_i],
+            out_specs=qspec_i,
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(lens_i, q, k, v, do, o, lse)
+
+    # dkv grid: (BH, k-block, q-block) — q-side operands indexed by the INNER id
+    qspec_in = pl.BlockSpec((1, bq, D), lambda b, j, i, lens_ref: (b, i, 0))
+    kspec_out = pl.BlockSpec((1, bk, D), lambda b, j, i, lens_ref: (b, j, 0))
+    lse_in = pl.BlockSpec((1, bq, 128), lambda b, j, i, lens_ref: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_dkv_kernel, causal, scale, nq, bq, bk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nk, nq),
+            in_specs=[qspec_in, kspec_out, kspec_out, qspec_in, qspec_in, lse_in],
+            out_specs=[kspec_out, kspec_out],
+            scratch_shapes=[
+                pltpu.VMEM((bk, D), jnp.float32),
+                pltpu.VMEM((bk, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(lens_i, q, k, v, do, o, lse)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------------
+# custom VJP over the (BH, S, D) view (Pallas path)
+# ---------------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash3(q, k, v, lens, causal, scale):
+    o, _ = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default())
+    return o
+
+
+def _flash3_fwd(q, k, v, lens, causal, scale):
+    o, lse = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default())
+    return o, (q, k, v, lens, o, lse)
+
+
+def _flash3_bwd(causal, scale, res, do):
+    q, k, v, lens, o, lse = res
+    dq, dk, dv = _fa_bwd_pallas(
+        q, k, v, do, o, lse, lens, causal, scale, _interpret_default()
+    )
+    return dq, dk, dv, jnp.zeros_like(lens)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+# ---------------------------------------------------------------------------------
+# jnp oracle — unfused but GSPMD-transparent; autodiff provides the backward
+# ---------------------------------------------------------------------------------
+
+
+def _attn_jnp(q, k, v, lens, causal, scale):
+    BH, S, D = q.shape
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    kj = jnp.arange(S)
+    masked = kj[None, None, :].astype(jnp.float32) >= lens[:, None, None]
+    if causal:
+        masked |= kj[None, :] > jnp.arange(S)[:, None]
+    s = jnp.where(masked, _NEG, s)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # zero masked slots explicitly: for a fully-masked row s == m == _NEG and
+    # exp(s - m) would be 1, not 0 (same guard as the Pallas kernel)
+    e = jnp.where(masked, 0.0, jnp.exp(s - m))
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    nonempty = l > 0.0
+    p = jnp.where(nonempty, e / jnp.where(nonempty, l, 1.0), 0.0)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------------
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_lens: Optional[jax.Array] = None,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Fused scaled-dot-product attention.
+
+    q, k, v: (B, H, S, D). ``kv_lens``: optional (B,) int key lengths — keys
+    at index >= len are masked out (the reference fmha's variable-seqlen
+    support, ref: apex/contrib/fmha/fmha.py:33-60, expressed padded-dense).
+    Returns (B, H, S, D) in q's dtype. fp32 accumulation throughout.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, H, S, D) inputs, got {q.shape}")
+    B, H, S, D = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}")
+    scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    forced = impl is not None
+    impl = _resolve_impl(impl)
+    if impl == "pallas" and not is_flash_available(S, D):
+        if forced:
+            # resolve_impl's contract: an explicit impl= is always honored —
+            # so an impossible forced request errors instead of a silent swap
+            raise ValueError(
+                f"impl='pallas' forced but shapes don't tile the kernel: "
+                f"S={S} (needs % {_MIN_BLOCK} == 0), head_dim={D} (needs 8..512); "
+                f"pass impl=None for automatic fallback"
+            )
+        impl = "jnp"
+
+    if kv_lens is None:
+        lens = jnp.full((B,), float(S), jnp.float32)
+    else:
+        lens = kv_lens.astype(jnp.float32)
+    lens_bh = jnp.repeat(lens, H)  # (B*H,): per-head copy of each seq length
+
+    q3 = q.reshape(B * H, S, D)
+    k3 = k.reshape(B * H, S, D)
+    v3 = v.reshape(B * H, S, D)
+    if impl == "pallas":
+        o = _flash3(q3, k3, v3, lens_bh, causal, scale)
+    else:
+        o = _attn_jnp(q3, k3, v3, lens_bh, causal, scale)
+    return o.reshape(B, H, S, D)
+
+
+def self_attention(
+    x: jax.Array,
+    w_qkv: jax.Array,
+    b_qkv: Optional[jax.Array],
+    w_out: jax.Array,
+    b_out: Optional[jax.Array],
+    n_heads: int,
+    *,
+    causal: bool = False,
+    kv_lens: Optional[jax.Array] = None,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Fused self-attention block: QKV projection → flash attention → output
+    projection (ref: apex/contrib/multihead_attn/self_multihead_attn.py:22,
+    whose CUDA Functions fuse exactly this chain). x: (B, S, D)."""
+    B, S, D = x.shape
+    hd = D // n_heads
+    if hd * n_heads != D:
+        raise ValueError(f"d_model {D} not divisible by n_heads {n_heads}")
+    qkv = x @ w_qkv.astype(x.dtype)
+    if b_qkv is not None:
+        qkv = qkv + b_qkv.astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, S, n_heads, hd).transpose(0, 2, 1, 3)
+
+    ctx = flash_attention(
+        heads(q), heads(k), heads(v), causal=causal, kv_lens=kv_lens, impl=impl
+    )
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+    out = ctx @ w_out.astype(x.dtype)
+    if b_out is not None:
+        out = out + b_out.astype(x.dtype)
+    return out

@@ -40,6 +40,11 @@ class GPTConfig:
     d_ff: Optional[int] = None  # default 4*d_model
     dtype: jnp.dtype = jnp.float32  # activation/compute dtype (params stay fp32)
     sequence_parallel: bool = False
+    # attention path: flash (Pallas, ref: contrib fmha/fast_multihead_attn) vs
+    # the materialized-scores softmax kernel; attention_impl forces the
+    # pallas/jnp dispatch for tests (None = resolve_impl policy)
+    use_flash_attention: bool = True
+    attention_impl: Optional[str] = None
 
     @property
     def ff(self) -> int:
@@ -128,6 +133,21 @@ def _constrain(x, spec: P):
     return x
 
 
+def _residual_spec(cfg: GPTConfig) -> P:
+    """Sharding of the residual stream between blocks.
+
+    With sequence_parallel the residual lives scattered along sequence over
+    the ``tensor`` axis (ref: mappings.py:205-260 — the scatter/gather/
+    reduce-scatter SP region ops). Under GSPMD the constraint alone makes XLA
+    insert the all-gather before the column-parallel GEMMs and the
+    reduce-scatter after the row-parallel ones (ref: layers.py:293-306,
+    355-363 does this by hand).
+    """
+    if cfg.sequence_parallel:
+        return P(DATA_AXIS, TENSOR_AXIS, None)
+    return P(DATA_AXIS, None, None)
+
+
 def _layernorm(x, scale, bias):
     # params may be fp32 under an amp policy while activations are bf16 —
     # passed through uncast: the fused kernel computes in fp32 internally, so
@@ -150,17 +170,27 @@ def _block(cfg: GPTConfig, x, lp):
     q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    scores = (q @ k.transpose(0, 1, 3, 2)).reshape(B * H, S, S)
-    probs = scaled_upper_triang_masked_softmax(
-        scores, 1.0 / np.sqrt(hd)
-    ).astype(x.dtype).reshape(B, H, S, S)
-    ctx = (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, D)
+    if cfg.use_flash_attention:
+        # Pallas flash attention — no (B*H, S, S) score tensor in HBM
+        from beforeholiday_tpu.ops import flash_attention
+
+        ctx = flash_attention(
+            q, k, v, causal=True, scale=1.0 / np.sqrt(hd), impl=cfg.attention_impl
+        )
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+    else:
+        scores = (q @ k.transpose(0, 1, 3, 2)).reshape(B * H, S, S)
+        probs = scaled_upper_triang_masked_softmax(
+            scores, 1.0 / np.sqrt(hd)
+        ).astype(x.dtype).reshape(B, H, S, S)
+        ctx = (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, D)
     x = x + fused_dense(ctx, lp["wo"].astype(x.dtype), lp["bo"].astype(x.dtype))
+    x = _constrain(x, _residual_spec(cfg))
 
     h = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
     h = jax.nn.gelu(fused_dense(h, lp["wi"].astype(h.dtype), lp["bi"].astype(h.dtype)))
     x = x + fused_dense(h, lp["wo2"].astype(x.dtype), lp["bo2"].astype(x.dtype))
-    return x
+    return _constrain(x, _residual_spec(cfg))
 
 
 def forward(params: dict, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
@@ -168,7 +198,7 @@ def forward(params: dict, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
     B, S = tokens.shape
     x = params["tok_embed"][tokens] + params["pos_embed"][:S]
     x = x.astype(cfg.dtype)
-    x = _constrain(x, P(DATA_AXIS, None, None))
+    x = _constrain(x, _residual_spec(cfg))
 
     def body(carry, lp):
         return _block(cfg, carry, lp), None

@@ -134,7 +134,7 @@ def _pipelined_fwd_bwd(
             f"interleaved schedule needs num_microbatches ({M}) divisible by "
             f"pipeline size ({S}), as the reference asserts"
         )
-    total_ticks = M * V + V * S + S - 1 if V > 1 else M + 2 * S - 1
+    total_ticks = M * V + V * S + S - 1  # at V=1: the familiar M + 2S - 1
     ring_depth = activation_ring_depth(V, S)
 
     is_first_dev = rank == 0
@@ -226,11 +226,12 @@ def _pipelined_fwd_bwd(
                 mb_loss, (dsp, dhp, dx) = jax.value_and_grad(full, argnums=(0, 1, 2))(
                     sp_b, head_params, x_saved
                 )
-                return mb_loss, dsp, dhp, dx
+                return mb_loss.astype(jnp.float32), dsp, dhp, dx
             mb_loss, (dsp, dx) = jax.value_and_grad(
                 lambda sp, x: full(sp, None, x), argnums=(0, 1)
             )(sp_b, x_saved)
-            return mb_loss, dsp, zeros_head_g, dx
+            # f32 so both lax.cond branches agree even for low-precision losses
+            return mb_loss.astype(jnp.float32), dsp, zeros_head_g, dx
 
         def inner_branch():
             _, vjp = jax.vjp(lambda sp, x: stage_fn(sp, x), sp_b, x_saved)
@@ -346,11 +347,9 @@ def forward_backward_pipelining_with_interleaving(
     (or ``PipelineGrads`` when embed/head are given).
     """
     V = virtual_pipeline_model_parallel_size
-    leaves = jax.tree.leaves(chunk_params)
-    if leaves and any(leaf.shape[0] != V for leaf in leaves):
-        raise ValueError(
-            f"chunk_params leaves must lead with V={V}, got {leaves[0].shape}"
-        )
+    bad = [leaf.shape for leaf in jax.tree.leaves(chunk_params) if leaf.shape[0] != V]
+    if bad:
+        raise ValueError(f"chunk_params leaves must lead with V={V}, got {bad[0]}")
     loss, g_stage, g_embed, g_head = _pipelined_fwd_bwd(
         stage_fn, loss_fn, chunk_params, inputs, targets, V=V, axis_name=axis_name,
         embed_fn=embed_fn, embed_params=embed_params,

@@ -1,0 +1,152 @@
+"""Flash attention parity: Pallas kernel (interpret mode on CPU) vs the
+unfused jnp oracle and vs the repo's existing unfused softmax path.
+
+Mirrors the reference's contrib tests (apex/contrib/test/fmha/test_fmha.py,
+multihead_attn/) which compare each fused op against a pure-PyTorch module.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beforeholiday_tpu.ops import attention as A
+
+
+def _ref_attn(q, k, v, causal, scale, kv_lens=None):
+    """Materialized-scores oracle in fp64-ish fp32."""
+    B, H, S, D = q.shape
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s * scale
+    kj = jnp.arange(S)
+    masked = jnp.zeros((B, 1, S, S), bool)
+    if kv_lens is not None:
+        masked = masked | (kj[None, None, None, :] >= kv_lens[:, None, None, None])
+    if causal:
+        masked = masked | (kj[None, None, None, :] > jnp.arange(S)[None, None, :, None])
+    s = jnp.where(masked, -1e30, s)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.where(masked, 0.0, jnp.exp(s - m))  # exact zero on masked slots
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    p = jnp.where(l > 0, e / jnp.where(l > 0, l, 1.0), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _qkv(key, B=2, H=2, S=256, D=64, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    mk = lambda k: jax.random.normal(k, (B, H, S, D), dtype)
+    return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_oracle(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        got = A.flash_attention(q, k, v, causal=causal, impl="pallas")
+        want = _ref_attn(q, k, v, causal, 1.0 / np.sqrt(64))
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def test_jnp_impl_matches_oracle(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1))
+        got = A.flash_attention(q, k, v, causal=True, impl="jnp")
+        want = _ref_attn(q, k, v, True, 1.0 / np.sqrt(64))
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def test_kv_lens_padding(self):
+        q, k, v = _qkv(jax.random.PRNGKey(2))
+        lens = jnp.array([128, 200])
+        got = A.flash_attention(q, k, v, causal=False, kv_lens=lens, impl="pallas")
+        want = _ref_attn(q, k, v, False, 1.0 / np.sqrt(64), lens)
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("impl", ["pallas", "jnp"])
+    def test_fully_masked_rows_zero(self, impl):
+        """kv_len == 0: 'pay attention to nothing' → zero output, no NaN, on
+        BOTH impls (the generic softmax kernel's fully-masked convention)."""
+        q, k, v = _qkv(jax.random.PRNGKey(3))
+        lens = jnp.array([0, 256])
+        got = A.flash_attention(q, k, v, causal=False, kv_lens=lens, impl=impl)
+        assert not np.any(np.isnan(np.asarray(got)))
+        np.testing.assert_allclose(got[0], np.zeros_like(got[0]), atol=0)
+
+    def test_custom_scale_and_bf16(self):
+        q, k, v = _qkv(jax.random.PRNGKey(4), dtype=jnp.bfloat16)
+        got = A.flash_attention(q, k, v, causal=True, scale=0.1, impl="pallas")
+        want = _ref_attn(q, k, v, True, 0.1)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            got.astype(np.float32), want.astype(np.float32), atol=2e-2, rtol=2e-2
+        )
+
+    def test_availability_gate(self):
+        assert A.is_flash_available(256, 64)
+        assert not A.is_flash_available(200, 64)  # ragged seq
+        assert not A.is_flash_available(256, 1024)  # head too wide
+        # ragged shapes silently take the jnp path rather than erroring
+        B, H, S, D = 1, 2, 96, 32
+        q = jax.random.normal(jax.random.PRNGKey(5), (B, H, S, D))
+        out = A.flash_attention(q, q, q, causal=True, impl=None)
+        np.testing.assert_allclose(
+            out, _ref_attn(q, q, q, True, 1.0 / np.sqrt(D)), atol=2e-5, rtol=2e-5
+        )
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_oracle(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(10), B=1, H=2, S=256, D=64)
+        w = jax.random.normal(jax.random.PRNGKey(11), q.shape)
+
+        def f(impl):
+            def loss(q, k, v):
+                o = A.flash_attention(q, k, v, causal=causal, impl=impl)
+                return jnp.sum(o * w)
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        dq_p, dk_p, dv_p = f("pallas")
+        def loss_ref(q, k, v):
+            return jnp.sum(_ref_attn(q, k, v, causal, 1.0 / np.sqrt(64)) * w)
+
+        dq_r, dk_r, dv_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(dq_p, dq_r, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(dk_p, dk_r, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(dv_p, dv_r, atol=1e-4, rtol=1e-4)
+
+    def test_grads_with_kv_lens(self):
+        q, k, v = _qkv(jax.random.PRNGKey(12), B=2, H=1, S=256, D=32)
+        lens = jnp.array([100, 256])
+        w = jax.random.normal(jax.random.PRNGKey(13), q.shape)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                A.flash_attention(q, k, v, causal=True, kv_lens=lens, impl="pallas") * w
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_ref_attn(q, k, v, True, 1.0 / np.sqrt(32), lens) * w)
+
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for g, r in zip(got, want):
+            np.testing.assert_allclose(g, r, atol=1e-4, rtol=1e-4)
+
+
+class TestSelfAttention:
+    def test_fused_block_matches_manual(self):
+        B, S, D, H = 2, 128, 64, 4
+        key = jax.random.PRNGKey(20)
+        ks = jax.random.split(key, 4)
+        x = jax.random.normal(ks[0], (B, S, D))
+        w_qkv = jax.random.normal(ks[1], (D, 3 * D)) * 0.05
+        b_qkv = jax.random.normal(ks[2], (3 * D,)) * 0.01
+        w_out = jax.random.normal(ks[3], (D, D)) * 0.05
+
+        got = A.self_attention(x, w_qkv, b_qkv, w_out, None, H, causal=True, impl="pallas")
+
+        qkv = x @ w_qkv + b_qkv
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        hs = lambda t: t.reshape(B, S, H, D // H).transpose(0, 2, 1, 3)
+        ctx = _ref_attn(hs(q), hs(k), hs(v), True, 1.0 / np.sqrt(D // H))
+        want = ctx.transpose(0, 2, 1, 3).reshape(B, S, D) @ w_out
+        np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-5)
