@@ -15,3 +15,16 @@ from beforeholiday_tpu.amp.frontend import (  # noqa: F401
     scaled_value_and_grad,
 )
 from beforeholiday_tpu.amp.scaler import LossScaler  # noqa: F401
+
+# per-op cast policy (the O1/O4 "patch engine"; ref: apex/amp/amp.py:29-71
+# decorators + lists/functional_overrides.py) — lives in ops to stay below
+# the op layer in the import graph, re-exported here as the reference's amp API
+from beforeholiday_tpu.ops._autocast import (  # noqa: F401
+    autocast,
+    autocast_dtype,
+    banned_function,
+    bfloat16_function,
+    float_function,
+    half_function,
+    promote_function,
+)

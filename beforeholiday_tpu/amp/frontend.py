@@ -122,14 +122,39 @@ class AmpModel:
     apply: Callable  # wrapped apply: casts inputs/outputs per policy
     params: Any  # storage-dtype params
     optimizer: Any  # possibly MasterWeights-wrapped
-    scaler: LossScaler
+    scaler: LossScaler  # scalers[0], kept as a field for the common case
+    scalers: Tuple[LossScaler, ...] = ()  # one per loss (ref: num_losses)
+
+    def __post_init__(self):
+        if not self.scalers:
+            self.scalers = (self.scaler,)
 
     def state_dict(self, scaler_state) -> Dict[str, Any]:
-        """Scaler checkpoint (ref: apex/amp/frontend.py:434-452 amp.state_dict)."""
-        return {"loss_scaler0": self.scaler.state_dict(scaler_state)}
+        """Scaler checkpoint (ref: apex/amp/frontend.py:434-452 amp.state_dict
+        — one ``loss_scaler{i}`` entry per loss). ``scaler_state`` is the
+        single state, or a sequence of per-loss states when num_losses > 1."""
+        states = (
+            list(scaler_state)
+            if isinstance(scaler_state, (list, tuple))
+            else [scaler_state]
+        )
+        if len(states) != len(self.scalers):
+            raise ValueError(
+                f"expected {len(self.scalers)} scaler states, got {len(states)}"
+            )
+        return {
+            f"loss_scaler{i}": s.state_dict(st)
+            for i, (s, st) in enumerate(zip(self.scalers, states))
+        }
 
-    def load_state_dict(self, state_dict) -> Dict[str, jax.Array]:
-        return self.scaler.load_state_dict(state_dict["loss_scaler0"])
+    def load_state_dict(self, state_dict):
+        """Inverse of ``state_dict`` (ref: frontend.py:454-473). Returns the
+        single scaler state, or the list of per-loss states."""
+        out = [
+            s.load_state_dict(state_dict[f"loss_scaler{i}"])
+            for i, s in enumerate(self.scalers)
+        ]
+        return out[0] if len(out) == 1 else out
 
 
 def initialize(
@@ -144,6 +169,7 @@ def initialize(
     loss_scale: Optional[Any] = None,
     keep_fp32_mask: Optional[Callable] = None,
     has_state: bool = False,
+    num_losses: int = 1,
 ) -> AmpModel:
     """Apply an opt-level policy to (apply_fn, params, optimizer).
 
@@ -162,6 +188,10 @@ def initialize(
     is passed through UNCAST in both directions: the reference's
     ``convert_network`` never casts BN buffers (apex/fp16_utils/fp16util.py),
     and low-precision round-trips would erode the running averages.
+
+    ``num_losses`` creates one independent LossScaler per loss (ref:
+    _initialize.py:229-233) — GAN-style multi-loss training scales each loss
+    with its own dynamic state; all land in ``state_dict`` as loss_scaler{i}.
     """
     if opt_level not in opt_levels:
         raise RuntimeError(
@@ -182,17 +212,20 @@ def initialize(
 
     cast_params = _cast_params(params, policy, keep_fp32_mask)
     amp_apply = make_apply(
-        policy, apply_fn, cast_model_outputs=cast_model_outputs, has_state=has_state
+        policy, apply_fn, cast_model_outputs=cast_model_outputs,
+        has_state=has_state, keep_fp32_mask=keep_fp32_mask,
     )
 
     opt = optimizer
     if opt is not None and policy.master_weights:
         opt = MasterWeights(opt)
 
-    scaler = LossScaler(loss_scale=policy.loss_scale)
+    if num_losses < 1:
+        raise ValueError(f"num_losses must be >= 1, got {num_losses}")
+    scalers = tuple(LossScaler(loss_scale=policy.loss_scale) for _ in range(num_losses))
     return AmpModel(
         policy=policy, apply=amp_apply, params=cast_params,
-        optimizer=opt, scaler=scaler,
+        optimizer=opt, scaler=scalers[0], scalers=scalers,
     )
 
 
@@ -202,27 +235,55 @@ def make_apply(
     *,
     cast_model_outputs: Optional[Any] = jnp.float32,
     has_state: bool = False,
+    keep_fp32_mask: Optional[Callable] = None,
 ) -> Callable:
     """Wrap ``apply_fn`` with a policy's input/param/output casts WITHOUT
     re-casting a params copy — for building extra apply variants (e.g. an
     eval-mode forward) that share an existing ``AmpModel``'s params."""
     compute_dtype = policy.compute_dtype
+    keep = keep_fp32_mask if keep_fp32_mask is not None else _default_keep_fp32
+
+    def _cast_params_keep_norms(p):
+        """O1/O4 boundary cast that leaves norm-ish params at full precision:
+        the reference's O1 keeps model weights fp32 and FP32_FUNCS consume
+        them uncast — bulk-down-casting gamma/beta would quantize them before
+        float_function re-promotes (a value-level divergence, not just dtype)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(p)
+        out = [
+            leaf
+            if (hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating)
+                and keep(path))
+            else _cast_floats(leaf, compute_dtype)
+            for path, leaf in flat
+        ]
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     def amp_apply(p, *inputs, **kwinputs):
+        from beforeholiday_tpu.ops._autocast import autocast
+        import contextlib
+
         if has_state:
             model_state, *inputs = inputs
         if policy.patch_torch_functions:
             # O1/O4: fp32 storage, low-precision compute — the cast happens at
-            # the trace boundary and XLA fuses it (the "cast cache" for free)
-            p = _cast_floats(p, compute_dtype)
+            # the trace boundary and XLA fuses it (the "cast cache" for free),
+            # AND the per-op policy activates: ops tagged float_function
+            # (norms/losses) re-promote their inputs to fp32, half ops
+            # (dense/mlp/attention) stay low-precision — the reference's
+            # FP32_FUNCS / FP16_FUNCS split (functional_overrides.py:17-91)
+            p = _cast_params_keep_norms(p)
+            scope = autocast(compute_dtype)
+        else:
+            scope = contextlib.nullcontext()
         inputs = _cast_floats(inputs, compute_dtype)
         kwinputs = _cast_floats(kwinputs, compute_dtype)
-        if has_state:
-            out, new_state = apply_fn(p, model_state, *inputs, **kwinputs)
-            if cast_model_outputs is not None:
-                out = _cast_floats(out, cast_model_outputs)
-            return out, new_state
-        out = apply_fn(p, *inputs, **kwinputs)
+        with scope:
+            if has_state:
+                out, new_state = apply_fn(p, model_state, *inputs, **kwinputs)
+                if cast_model_outputs is not None:
+                    out = _cast_floats(out, cast_model_outputs)
+                return out, new_state
+            out = apply_fn(p, *inputs, **kwinputs)
         if cast_model_outputs is not None:
             out = _cast_floats(out, cast_model_outputs)
         return out

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from beforeholiday_tpu.ops._autocast import autocast_dtype
 from beforeholiday_tpu.ops._pallas_util import (
     interpret_default as _interpret_default,
     resolve_impl as _resolve_impl,
@@ -388,6 +389,11 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected (B, H, S, D) inputs, got {q.shape}")
+    # FP16_FUNCS-style autocast applied by hand: only q/k/v are compute
+    # tensors — kv_lens is integer-semantic and must never be rounded
+    act = autocast_dtype()
+    if act is not None:
+        q, k, v = q.astype(act), k.astype(act), v.astype(act)
     B, H, S, D = q.shape
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}")
@@ -437,6 +443,12 @@ def self_attention(
     projection (ref: apex/contrib/multihead_attn/self_multihead_attn.py:22,
     whose CUDA Functions fuse exactly this chain). x: (B, S, D)."""
     B, S, D = x.shape
+    act = autocast_dtype()
+    if act is not None:  # cast compute tensors only, not kv_lens
+        x = x.astype(act)
+        w_qkv, w_out = w_qkv.astype(act), w_out.astype(act)
+        b_qkv = b_qkv.astype(act) if b_qkv is not None else None
+        b_out = b_out.astype(act) if b_out is not None else None
     hd = D // n_heads
     if hd * n_heads != D:
         raise ValueError(f"d_model {D} not divisible by n_heads {n_heads}")

@@ -16,6 +16,8 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.ops._autocast import half_function
+
 
 def _matmul(x, w):
     # fp32 MXU accumulation regardless of input dtype
@@ -24,6 +26,7 @@ def _matmul(x, w):
     )
 
 
+@half_function
 def fused_dense(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None):
     """GEMM + bias epilogue (ref: fused_dense_cuda.cu linear_bias_forward).
 
@@ -35,6 +38,7 @@ def fused_dense(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = Non
     return y.astype(x.dtype)
 
 
+@half_function
 def fused_dense_gelu_dense(
     x: jax.Array,
     weight1: jax.Array,
@@ -51,6 +55,7 @@ def fused_dense_gelu_dense(
     return y.astype(x.dtype)
 
 
+@half_function
 def mlp(
     x: jax.Array,
     weights: Sequence[jax.Array],

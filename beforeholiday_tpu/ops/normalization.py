@@ -29,6 +29,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from beforeholiday_tpu.ops._autocast import float_function
 from beforeholiday_tpu.ops._pallas_util import (
     interpret_default as _interpret_default,
     pad_rows as _pad_rows_util,
@@ -209,6 +210,7 @@ def _layer_norm_bwd(eps, rms, out_dtype, impl, res, dy):
 _layer_norm.defvjp(_layer_norm_fwd, _layer_norm_bwd)
 
 
+@float_function
 def fused_layer_norm(
     x: jax.Array,
     weight: jax.Array,
@@ -224,6 +226,7 @@ def fused_layer_norm(
     return _norm_impl(x, weight, bias, eps, rms=False, out_dtype=x.dtype, impl=impl)
 
 
+@float_function
 def fused_rms_norm(
     x: jax.Array,
     weight: jax.Array,

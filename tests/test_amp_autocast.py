@@ -1,0 +1,173 @@
+"""Per-op cast policy behavioral contracts (ref: tests/L0/run_amp/
+test_basic_casts.py, test_promotion.py — whitelist/blacklist/promote dtype
+outcomes) and multi-loss scaler checkpointing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beforeholiday_tpu import amp
+from beforeholiday_tpu.ops import fused_dense, fused_layer_norm, scaled_softmax
+
+
+class TestBasicCasts:
+    def test_half_op_casts_down(self):
+        """Whitelist contract: fused_dense runs in the autocast dtype."""
+        x = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((8, 8), jnp.float32)
+        assert fused_dense(x, w).dtype == jnp.float32  # inert outside scope
+        with amp.autocast(jnp.float16):
+            assert fused_dense(x, w).dtype == jnp.float16
+        with amp.autocast(jnp.bfloat16):
+            assert fused_dense(x, w).dtype == jnp.bfloat16
+
+    def test_float_op_casts_up(self):
+        """Blacklist contract: norms run fp32 on low-precision inputs."""
+        x = jnp.ones((4, 8), jnp.float16)
+        s = jnp.ones((8,), jnp.float16)
+        b = jnp.zeros((8,), jnp.float16)
+        assert fused_layer_norm(x, s, b).dtype == jnp.float16  # inert outside
+        with amp.autocast(jnp.float16):
+            assert fused_layer_norm(x, s, b).dtype == jnp.float32
+            # the megatron softmax KERNELS take half inputs directly (they are
+            # not FP32_FUNCS — only generic F.softmax is); dtype passes through
+            assert scaled_softmax(x).dtype == jnp.float16
+
+    def test_jit_cache_respects_scope(self):
+        """The scope is part of jit's trace context: a trace cached outside
+        autocast must NOT be reused inside it (and vice versa)."""
+        f = jax.jit(lambda x, w: fused_dense(x, w))
+        x = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((8, 8), jnp.float32)
+        assert f(x, w).dtype == jnp.float32  # caches the fp32 trace
+        with amp.autocast(jnp.bfloat16):
+            assert f(x, w).dtype == jnp.bfloat16  # fresh trace, policy applied
+        assert f(x, w).dtype == jnp.float32
+
+    def test_kv_lens_never_cast(self):
+        """flash_attention under autocast casts q/k/v only — a float kv_lens
+        above the fp16 integer range must not be rounded."""
+        from beforeholiday_tpu.ops import flash_attention
+
+        B, H, S, D = 1, 1, 128, 32
+        q = jnp.ones((B, H, S, D), jnp.float32)
+        lens = jnp.array([100.0])
+        with amp.autocast(jnp.float16):
+            out = flash_attention(q, q, q, kv_lens=lens, impl="jnp")
+            assert out.dtype == jnp.float16  # q/k/v were cast
+        ref = flash_attention(q, q, q, kv_lens=jnp.array([100]), impl="jnp")
+        np.testing.assert_allclose(
+            out.astype(jnp.float32), ref.astype(jnp.float32), atol=2e-3
+        )
+
+    def test_promote_widest_wins(self):
+        @amp.promote_function
+        def add(a, b):
+            return a + b
+
+        a16 = jnp.ones((4,), jnp.float16)
+        a32 = jnp.ones((4,), jnp.float32)
+        with amp.autocast(jnp.float16):
+            assert add(a16, a32).dtype == jnp.float32
+            assert add(a16, a16).dtype == jnp.float16
+
+    def test_banned_raises_under_fp16(self):
+        """ref: functional_overrides.py:80-91 BANNED_FUNCS."""
+        bce = amp.banned_function(
+            lambda p, t: -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p)).mean(),
+            "binary_cross_entropy",
+            "use a loss computed from logits instead",
+        )
+        p = jnp.full((4,), 0.5)
+        t = jnp.ones((4,))
+        float(bce(p, t))  # fine outside autocast
+        with amp.autocast(jnp.bfloat16):
+            float(bce(p, t))  # bf16 has fp32's range; allowed
+        with amp.autocast(jnp.float16):
+            with pytest.raises(RuntimeError, match="binary_cross_entropy"):
+                bce(p, t)
+
+    def test_scope_nests_and_restores(self):
+        assert amp.autocast_dtype() is None
+        with amp.autocast(jnp.float16):
+            assert amp.autocast_dtype() == jnp.float16
+            with amp.autocast(jnp.bfloat16):
+                assert amp.autocast_dtype() == jnp.bfloat16
+            assert amp.autocast_dtype() == jnp.float16
+        assert amp.autocast_dtype() is None
+
+
+class TestO1PerOpPolicy:
+    """O1/O4 activate the scope through the amp apply wrapper: GEMMs run low
+    precision, FP32_FUNCS stay fp32 — no longer O3-with-fp32-storage."""
+
+    @pytest.mark.parametrize(
+        "opt_level,low", [("O1", jnp.float16), ("O4", jnp.bfloat16)]
+    )
+    def test_norm_fp32_dense_low(self, opt_level, low):
+        seen = {}
+
+        def model(p, x):
+            h = fused_dense(x, p["w1"])
+            seen["dense"] = h.dtype
+            seen["gamma"] = p["ln_scale"].dtype
+            h = fused_layer_norm(h, p["ln_scale"], p["ln_bias"])
+            seen["norm"] = h.dtype
+            return fused_dense(h, p["w2"])
+
+        params = {
+            "w1": jnp.ones((8, 8)), "w2": jnp.ones((8, 8)),
+            "ln_scale": jnp.ones((8,)), "ln_bias": jnp.zeros((8,)),
+        }
+        m = amp.initialize(model, params, opt_level=opt_level, cast_model_outputs=None)
+        out = m.apply(m.params, jnp.ones((2, 8)))
+        assert seen["dense"] == low        # whitelist op went low-precision
+        assert seen["norm"] == jnp.float32  # blacklist op promoted to fp32
+        # norm params reach their op UNQUANTIZED (the reference keeps model
+        # weights fp32 under O1; bulk-down-casting gamma would lose values)
+        assert seen["gamma"] == jnp.float32
+        assert out.dtype == low            # final dense pulled it back down
+
+    def test_o2_does_not_activate_scope(self):
+        def model(p, x):
+            assert amp.autocast_dtype() is None  # cast-model levels don't patch
+            return x @ p["w"]
+
+        m = amp.initialize(model, {"w": jnp.ones((4, 4))}, opt_level="O2",
+                           cast_model_outputs=None)
+        m.apply(m.params, jnp.ones((2, 4)))
+
+
+class TestMultiLossScalers:
+    def test_per_loss_scaler_states_roundtrip(self):
+        """ref: _initialize.py:229-233 (one scaler per loss) +
+        frontend.py:434-473 (state_dict covers all of them)."""
+        m = amp.initialize(lambda p, x: x, {}, opt_level="O2", num_losses=2)
+        assert len(m.scalers) == 2 and m.scalers[0] is m.scaler
+        s0 = m.scalers[0].init()
+        s1 = m.scalers[1].init()
+        # advance scaler 1 only: overflow halves its scale
+        s1 = m.scalers[1].update(s1, jnp.bool_(True))
+        sd = m.state_dict([s0, s1])
+        assert set(sd) == {"loss_scaler0", "loss_scaler1"}
+        r0, r1 = m.load_state_dict(sd)
+        assert float(r0["scale"]) == 65536.0
+        assert float(r1["scale"]) == 32768.0
+
+    def test_single_loss_back_compat(self):
+        m = amp.initialize(lambda p, x: x, {}, opt_level="O2")
+        st = m.scaler.init()
+        sd = m.state_dict(st)
+        assert set(sd) == {"loss_scaler0"}
+        restored = m.load_state_dict(sd)  # single state, not a list
+        assert float(restored["scale"]) == float(st["scale"])
+
+    def test_state_count_mismatch_raises(self):
+        m = amp.initialize(lambda p, x: x, {}, opt_level="O2", num_losses=2)
+        with pytest.raises(ValueError, match="expected 2 scaler states"):
+            m.state_dict(m.scaler.init())
+
+    def test_bad_num_losses(self):
+        with pytest.raises(ValueError, match="num_losses"):
+            amp.initialize(lambda p, x: x, {}, opt_level="O2", num_losses=0)
