@@ -20,6 +20,7 @@ becomes explicit dataflow:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -27,7 +28,8 @@ import jax
 import jax.numpy as jnp
 
 from beforeholiday_tpu.amp.scaler import LossScaler
-from beforeholiday_tpu.optimizers.fused import MasterWeights, _cast_floats
+from beforeholiday_tpu.ops._autocast import autocast, cast_floats as _cast_floats
+from beforeholiday_tpu.optimizers.fused import MasterWeights
 from beforeholiday_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -259,9 +261,6 @@ def make_apply(
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def amp_apply(p, *inputs, **kwinputs):
-        from beforeholiday_tpu.ops._autocast import autocast
-        import contextlib
-
         if has_state:
             model_state, *inputs = inputs
         if policy.patch_torch_functions:

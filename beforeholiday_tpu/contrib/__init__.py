@@ -1,3 +1,5 @@
 """Optional accelerated modules (ref: apex/contrib/)."""
 
 from beforeholiday_tpu.contrib.clip_grad import clip_grad_norm_  # noqa: F401
+from beforeholiday_tpu.contrib.focal_loss import focal_loss  # noqa: F401
+from beforeholiday_tpu.contrib.xentropy import softmax_cross_entropy_loss  # noqa: F401

@@ -1,0 +1,81 @@
+"""Fused sigmoid focal loss for detection heads
+(ref: apex/contrib/focal_loss/focal_loss.py:6,
+csrc/focal_loss/focal_loss_cuda_kernel.cu:17-133).
+
+Reference semantics, reproduced exactly:
+
+* ``cls_targets`` holds one int per anchor: a class index >= 0 (positive
+  match), -1 (all-negative / background), or -2 (ignored: zero loss & grad);
+* classes at index >= ``num_real_classes`` are padding and contribute zero;
+* per-element, with p the logit and sigma = sigmoid(p)
+  (kernel :70-99): negatives get coeff (1-alpha)*sigma^gamma on the
+  CE term -log(1-sigma) (label-smoothed: targets s/K), positives get
+  alpha*(1-sigma)^gamma on -log(sigma) (smoothed: 1-s+s/K);
+* the summed loss is normalized by ``num_positives_sum`` (kernel :30).
+
+TPU design: this is a pure elementwise chain — exactly what XLA fuses into
+one kernel on its own — so the implementation is jnp with jax autodiff for
+the backward (the CUDA kernel exists because torch eager could not fuse it;
+a Pallas kernel would add nothing but bytes). The smoothed CE uses the
+numerically-stable softplus decomposition the kernel uses
+(off_a = -log(sigma) via log1p(exp(-|p|)) + max(-p, 0)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.ops._autocast import float_function
+
+
+@float_function
+def focal_loss(
+    cls_output: jax.Array,
+    cls_targets: jax.Array,
+    num_positives_sum: jax.Array,
+    num_real_classes: int,
+    alpha: float,
+    gamma: float,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Scalar sigmoid focal loss (ref: focal_loss.py:42-61 ``focal_loss``).
+
+    cls_output (..., K) logits over K (possibly padded) classes;
+    cls_targets (...,) int per anchor (>=0 class id, -1 negative, -2 ignore);
+    num_positives_sum: scalar normalizer (clamped to >= 1 like the reference
+    wrapper usage).
+    """
+    K = cls_output.shape[-1]
+    if cls_targets.shape != cls_output.shape[:-1]:
+        raise ValueError(
+            f"cls_targets {cls_targets.shape} must match anchors {cls_output.shape[:-1]}"
+        )
+    p = cls_output.astype(jnp.float32)
+    y = cls_targets.astype(jnp.int32)[..., None]  # (..., 1)
+    cols = jnp.arange(K, dtype=jnp.int32)
+    is_pos = (y >= 0) & (cols == y)  # one-hot of the matched class
+    ignored = y == -2
+    pad_class = cols >= num_real_classes
+
+    sigma = jax.nn.sigmoid(p)
+    # off_a = -log(sigmoid(p)), stable (kernel :74-77)
+    off_a = jnp.log1p(jnp.exp(-jnp.abs(p))) + jnp.maximum(-p, 0.0)
+
+    s = float(label_smoothing)
+    if s > 0.0:
+        nn_norm, np_norm = 1.0 - s / K, s / K
+        pn_norm, pp_norm = s - s / K, 1.0 - s + s / K
+        base = jnp.where(is_pos, pn_norm * p, nn_norm * p)
+    else:
+        base = jnp.where(is_pos, 0.0, p)
+    coeff_f = jnp.where(
+        is_pos,
+        alpha * jnp.power(1.0 - sigma, gamma),
+        (1.0 - alpha) * jnp.power(sigma, gamma),
+    )
+    loss_t = coeff_f * (base + off_a)
+    loss_t = jnp.where(ignored | pad_class, 0.0, loss_t)
+    # clamp: a zero-positive batch (all background) must not divide by zero
+    npos = jnp.maximum(num_positives_sum.reshape(()).astype(jnp.float32), 1.0)
+    return jnp.sum(loss_t) / npos

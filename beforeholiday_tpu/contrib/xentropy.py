@@ -1,0 +1,200 @@
+"""Fused label-smoothing softmax cross entropy
+(ref: apex/contrib/xentropy/softmax_xentropy.py:4, csrc kernel
+apex/contrib/csrc/xentropy/xentropy_kernel.cu:394-460).
+
+Reference semantics, reproduced exactly:
+
+* per-row loss = (1-s) * (lse - x[label]) + s * (lse - mean(x))
+  with lse = max + log(sum(exp(x - max)))  (kernel line 436-438);
+* rows whose label == padding_idx contribute 0 loss and 0 grad
+  (softmax_xentropy.py:9 ``masked_fill_``);
+* backward dx_j = dy * (softmax_j - ((1-s) * onehot_j + s/V))
+  (kernel ``apply``: smooth_positives/negatives, :452-453);
+* ``half_to_float`` returns fp32 losses from half inputs.
+
+TPU design: one Pallas row-block kernel (rows x full vocab per block — the
+whole-row reduction matches the reference's one-block-per-sample layout),
+labels ride scalar prefetch, loss/lse come back lane-replicated (the TPU
+layout for per-row scalars). The backward recomputes softmax from the saved
+(logits, lse) instead of the reference's in-place gradInput aliasing — same
+memory shape (one logits-sized buffer), functional semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from beforeholiday_tpu.ops._autocast import float_function
+from beforeholiday_tpu.ops._pallas_util import (
+    interpret_default as _interpret_default,
+    pad_rows as _pad_rows_util,
+    resolve_impl as _resolve_impl,
+)
+
+_BR = 8  # rows per block (fp32 sublane tile)
+
+
+def _row_labels(labels_ref, r0):
+    """Gather this block's labels: _BR dynamic SMEM scalar reads."""
+    return jnp.stack([labels_ref[r0 + i] for i in range(_BR)])
+
+
+def _xent_fwd_kernel(smoothing, V, labels_ref, x_ref, loss_ref, lse_ref):
+    r0 = pl.program_id(0) * _BR
+    x = x_ref[...].astype(jnp.float32)  # (BR, V)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    sumexp = jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True)
+    lse = m + jnp.log(sumexp)  # (BR, 1)
+    lab = _row_labels(labels_ref, r0)  # (BR,)
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    tgt = jnp.sum(jnp.where(cols == lab[:, None], x, 0.0), axis=-1, keepdims=True)
+    loss = (1.0 - smoothing) * (lse - tgt) + smoothing * (
+        lse - jnp.sum(x, axis=-1, keepdims=True) / V
+    )
+    loss_ref[...] = jnp.broadcast_to(loss, loss_ref.shape)  # lane-replicated
+    lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+def _xent_bwd_kernel(smoothing, V, labels_ref, x_ref, lse_ref, dy_ref, dx_ref):
+    r0 = pl.program_id(0) * _BR
+    x = x_ref[...].astype(jnp.float32)
+    lse = lse_ref[:, 0:1]
+    dy = dy_ref[:, 0:1]
+    lab = _row_labels(labels_ref, r0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = (cols == lab[:, None]).astype(jnp.float32)
+    soft = jnp.exp(x - lse)
+    dx = dy * (soft - ((1.0 - smoothing) * onehot + smoothing / V))
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _fwd_pallas(logits, labels, smoothing, interpret):
+    N, V = logits.shape
+    xp, _ = _pad_rows_util(logits, _BR)
+    labp, _ = _pad_rows_util(labels.astype(jnp.int32), _BR)
+    grid = xp.shape[0] // _BR
+    row = pl.BlockSpec((_BR, V), lambda i, lr: (i, 0))
+    vec = pl.BlockSpec((_BR, 128), lambda i, lr: (i, 0))
+    loss, lse = pl.pallas_call(
+        functools.partial(_xent_fwd_kernel, smoothing, V),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(grid,), in_specs=[row],
+            out_specs=[vec, vec],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], 128), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(labp, xp)
+    return loss[:N, 0], lse[:N, 0]
+
+
+def _bwd_pallas(logits, labels, lse, dy, smoothing, interpret):
+    N, V = logits.shape
+    xp, _ = _pad_rows_util(logits, _BR)
+    labp, _ = _pad_rows_util(labels.astype(jnp.int32), _BR)
+    rows = xp.shape[0]
+    lse2, _ = _pad_rows_util(jnp.broadcast_to(lse[:, None], (N, 128)), _BR)
+    dy2, _ = _pad_rows_util(jnp.broadcast_to(dy[:, None], (N, 128)), _BR)
+    grid = rows // _BR
+    row = pl.BlockSpec((_BR, V), lambda i, lr: (i, 0))
+    vec = pl.BlockSpec((_BR, 128), lambda i, lr: (i, 0))
+    dx = pl.pallas_call(
+        functools.partial(_xent_bwd_kernel, smoothing, V),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(grid,), in_specs=[row, vec, vec],
+            out_specs=row,
+        ),
+        out_shape=jax.ShapeDtypeStruct(xp.shape, logits.dtype),
+        interpret=interpret,
+    )(labp, xp, lse2, dy2)
+    return dx[:N]
+
+
+def _fwd_jnp(logits, labels, smoothing):
+    x = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(x, axis=-1)
+    tgt = jnp.take_along_axis(x, labels[:, None], axis=-1)[:, 0]
+    V = x.shape[-1]
+    loss = (1.0 - smoothing) * (lse - tgt) + smoothing * (lse - jnp.mean(x, axis=-1))
+    return loss, lse
+
+
+def _bwd_jnp(logits, labels, lse, dy, smoothing):
+    x = logits.astype(jnp.float32)
+    V = x.shape[-1]
+    onehot = jax.nn.one_hot(labels, V, dtype=jnp.float32)
+    soft = jnp.exp(x - lse[:, None])
+    dx = dy[:, None] * (soft - ((1.0 - smoothing) * onehot + smoothing / V))
+    return dx.astype(logits.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _xent(logits, labels, smoothing, impl):
+    loss, _ = (
+        _fwd_pallas(logits, labels, smoothing, _interpret_default())
+        if impl == "pallas"
+        else _fwd_jnp(logits, labels, smoothing)
+    )
+    return loss
+
+
+def _xent_fwd(logits, labels, smoothing, impl):
+    if impl == "pallas":
+        loss, lse = _fwd_pallas(logits, labels, smoothing, _interpret_default())
+    else:
+        loss, lse = _fwd_jnp(logits, labels, smoothing)
+    return loss, (logits, labels, lse)
+
+
+def _xent_bwd(smoothing, impl, res, dy):
+    logits, labels, lse = res
+    if impl == "pallas":
+        dx = _bwd_pallas(logits, labels, lse, dy, smoothing, _interpret_default())
+    else:
+        dx = _bwd_jnp(logits, labels, lse, dy, smoothing)
+    zero_lab = jnp.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dx, zero_lab
+
+
+_xent.defvjp(_xent_fwd, _xent_bwd)
+
+
+@float_function
+def softmax_cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    smoothing: float = 0.0,
+    padding_idx: int = 0,
+    half_to_float: bool = False,
+    *,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Per-row fused softmax CE with label smoothing
+    (ref: SoftmaxCrossEntropyLoss.apply, softmax_xentropy.py:6-28).
+
+    logits (N, V); labels (N,) int. Rows with label == padding_idx yield zero
+    loss AND zero gradient. Returns (N,) losses in logits' dtype, or fp32
+    when ``half_to_float``.
+    """
+    if logits.ndim != 2 or labels.ndim != 1 or labels.shape[0] != logits.shape[0]:
+        raise ValueError(
+            f"expected logits (N, V) and labels (N,), got {logits.shape} / {labels.shape}"
+        )
+    impl = _resolve_impl(impl)
+    labels = labels.astype(jnp.int32)
+    not_pad = labels != padding_idx
+    # zeroing the padded labels' grads: scale the per-row loss by a 0/1 mask
+    # BEFORE reduction-by-caller, which also zeroes dy for those rows — the
+    # reference's two masked_fill_ calls in one
+    loss = _xent(logits, labels, float(smoothing), impl)
+    loss = jnp.where(not_pad, loss, 0.0)
+    out_dtype = jnp.float32 if half_to_float else logits.dtype
+    return loss.astype(out_dtype)

@@ -85,7 +85,9 @@ def autocast_dtype() -> Optional[Any]:
     return jnp.dtype(name) if name else None
 
 
-def _cast_tree(tree, dtype):
+def cast_floats(tree, dtype):
+    """Cast every floating leaf to ``dtype`` — THE canonical helper (amp's
+    frontend and the fused optimizers import it from here)."""
     return jax.tree.map(
         lambda x: x.astype(dtype)
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
@@ -94,13 +96,17 @@ def _cast_tree(tree, dtype):
     )
 
 
+_cast_tree = cast_floats
+
+
 def _widest_float(tree):
+    """jnp's own promotion over the floating leaves — fp16+bf16 promotes to
+    fp32 (not whichever 2-byte dtype came first)."""
     widest = None
     for leaf in jax.tree.leaves(tree):
         if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
             dt = jnp.dtype(leaf.dtype)
-            if widest is None or dt.itemsize > widest.itemsize:
-                widest = dt
+            widest = dt if widest is None else jnp.promote_types(widest, dt)
     return widest
 
 

@@ -67,10 +67,11 @@ def resolve_impl(impl: Optional[str]) -> str:
     return impl
 
 
-def pad_rows(x2d: jax.Array, block_rows: int):
-    """Pad the leading dim to a multiple of block_rows. Returns (padded, rows)."""
-    rows = x2d.shape[0]
+def pad_rows(x: jax.Array, block_rows: int):
+    """Pad the leading dim to a multiple of block_rows (any rank).
+    Returns (padded, rows)."""
+    rows = x.shape[0]
     padded = ((rows + block_rows - 1) // block_rows) * block_rows
     if padded != rows:
-        x2d = jnp.pad(x2d, ((0, padded - rows), (0, 0)))
-    return x2d, rows
+        x = jnp.pad(x, ((0, padded - rows),) + ((0, 0),) * (x.ndim - 1))
+    return x, rows

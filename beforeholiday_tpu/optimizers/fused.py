@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from beforeholiday_tpu.ops import multi_tensor as mt
+from beforeholiday_tpu.ops._autocast import cast_floats as _cast_floats
 
 Mask = Union[None, Any, Callable[[Tuple[Any, ...]], bool]]
 
@@ -493,15 +494,6 @@ class FusedLARS(_FusedOptimizer):
 
         unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
         return unflat(new_p), {"momentum_buffer": unflat(new_b), "step": step_no}
-
-
-def _cast_floats(tree, dtype):
-    return jax.tree.map(
-        lambda x: x.astype(dtype)
-        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
-        else x,
-        tree,
-    )
 
 
 class MasterWeights:

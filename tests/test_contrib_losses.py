@@ -1,0 +1,140 @@
+"""Contrib fused losses vs independent oracles
+(ref: apex/contrib/test/test_label_smoothing.py compares the CUDA kernel
+against a pure-PyTorch label-smoothing CE; same strategy here with torch on
+CPU as the oracle)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from beforeholiday_tpu.contrib import focal_loss, softmax_cross_entropy_loss
+
+
+def _torch_smoothed_ce(logits, labels, smoothing):
+    """The reference test's oracle (test_label_smoothing.py label_smoothing_raw):
+    (1-s) * nll + s * mean over classes of -log_prob."""
+    logp = F.log_softmax(logits, dim=-1)
+    nll = -logp.gather(1, labels.unsqueeze(1)).squeeze(1)
+    smooth = -logp.mean(dim=-1)
+    return (1 - smoothing) * nll + smoothing * smooth
+
+
+class TestXentropy:
+    @pytest.mark.parametrize("impl", ["pallas", "jnp"])
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_matches_torch(self, impl, smoothing):
+        N, V = 24, 384
+        rng = np.random.RandomState(0)
+        x = rng.randn(N, V).astype(np.float32) * 2
+        lab = rng.randint(1, V, N)
+        got = softmax_cross_entropy_loss(
+            jnp.asarray(x), jnp.asarray(lab), smoothing=smoothing, impl=impl
+        )
+        want = _torch_smoothed_ce(torch.tensor(x), torch.tensor(lab), smoothing)
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("impl", ["pallas", "jnp"])
+    def test_grads_match_torch(self, impl):
+        N, V = 16, 256
+        rng = np.random.RandomState(1)
+        x = rng.randn(N, V).astype(np.float32)
+        lab = rng.randint(1, V, N)
+        s = 0.2
+
+        g = jax.grad(
+            lambda x: jnp.sum(
+                softmax_cross_entropy_loss(x, jnp.asarray(lab), smoothing=s, impl=impl)
+            )
+        )(jnp.asarray(x))
+
+        xt = torch.tensor(x, requires_grad=True)
+        _torch_smoothed_ce(xt, torch.tensor(lab), s).sum().backward()
+        np.testing.assert_allclose(np.asarray(g), xt.grad.numpy(), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("impl", ["pallas", "jnp"])
+    def test_padding_idx_zeroes_loss_and_grad(self, impl):
+        N, V = 8, 128
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(N, V).astype(np.float32))
+        lab = jnp.asarray([0, 5, 0, 7, 9, 0, 3, 2])  # padding_idx=0 rows
+        loss = softmax_cross_entropy_loss(x, lab, padding_idx=0, impl=impl)
+        assert np.all(np.asarray(loss)[np.asarray(lab) == 0] == 0.0)
+        g = jax.grad(
+            lambda x: jnp.sum(softmax_cross_entropy_loss(x, lab, padding_idx=0, impl=impl))
+        )(x)
+        g = np.asarray(g)
+        assert np.all(g[np.asarray(lab) == 0] == 0.0)
+        assert np.any(g[np.asarray(lab) != 0] != 0.0)
+
+    def test_half_to_float_and_ragged_rows(self):
+        # N not a multiple of the row block exercises the pad/slice path
+        N, V = 11, 96  # V also not a multiple of 128: full-row block still tiles
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(N, V).astype(np.float16))
+        lab = jnp.asarray(rng.randint(1, V, N))
+        out = softmax_cross_entropy_loss(x, lab, half_to_float=True, impl="pallas")
+        assert out.dtype == jnp.float32 and out.shape == (N,)
+        ref = softmax_cross_entropy_loss(
+            x.astype(jnp.float32), lab, impl="jnp"
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError, match="expected logits"):
+            softmax_cross_entropy_loss(jnp.ones((4, 8, 2)), jnp.zeros((4,), jnp.int32))
+
+
+def _torch_focal(p, y, npos, K_real, alpha, gamma, s):
+    """Independent oracle: per-element smoothed sigmoid CE weighted by the
+    focal modulation, summed / npos."""
+    K = p.shape[-1]
+    onehot = torch.zeros_like(p)
+    pos = y >= 0
+    onehot[pos] = F.one_hot(y[pos].long(), K).float()
+    t = onehot * (1 - s + s / K) + (1 - onehot) * (s / K)  # smoothed targets
+    ce = F.binary_cross_entropy_with_logits(p, t, reduction="none")
+    sigma = torch.sigmoid(p)
+    pt_mod = torch.where(onehot > 0, (1 - sigma) ** gamma, sigma ** gamma)
+    a_t = torch.where(onehot > 0, torch.full_like(p, alpha), torch.full_like(p, 1 - alpha))
+    loss = a_t * pt_mod * ce
+    loss[y == -2] = 0.0
+    loss[..., K_real:] = 0.0
+    return loss.sum() / npos
+
+
+class TestFocalLoss:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_matches_oracle(self, smoothing):
+        N, K = 64, 16
+        rng = np.random.RandomState(0)
+        p = rng.randn(N, K).astype(np.float32)
+        y = rng.randint(-2, K - 2, N)  # mix of ignore/-1/positives
+        npos = float(max((y >= 0).sum(), 1))
+        got = focal_loss(
+            jnp.asarray(p), jnp.asarray(y), jnp.float32(npos), K - 2, 0.25, 2.0,
+            smoothing,
+        )
+        want = _torch_focal(
+            torch.tensor(p), torch.tensor(y), npos, K - 2, 0.25, 2.0, smoothing
+        )
+        np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+    def test_grads_finite_and_ignore_zeroed(self):
+        N, K = 32, 8
+        rng = np.random.RandomState(1)
+        p = jnp.asarray(rng.randn(N, K).astype(np.float32))
+        y = jnp.asarray(rng.randint(-2, K, N))
+        g = jax.grad(
+            lambda p: focal_loss(p, y, jnp.float32(4.0), K, 0.25, 2.0, 0.1)
+        )(p)
+        g = np.asarray(g)
+        assert np.all(np.isfinite(g))
+        assert np.all(g[np.asarray(y) == -2] == 0.0)
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError, match="cls_targets"):
+            focal_loss(jnp.ones((4, 8)), jnp.zeros((3,), jnp.int32),
+                       jnp.float32(1.0), 8, 0.25, 2.0)
