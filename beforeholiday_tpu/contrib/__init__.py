@@ -1,5 +1,22 @@
 """Optional accelerated modules (ref: apex/contrib/)."""
 
+from beforeholiday_tpu.contrib.bottleneck import (  # noqa: F401
+    BottleneckParams,
+    bottleneck,
+    conv_bias,
+    conv_bias_mask_relu,
+    conv_bias_relu,
+    init_bottleneck,
+    spatial_bottleneck,
+)
 from beforeholiday_tpu.contrib.clip_grad import clip_grad_norm_  # noqa: F401
 from beforeholiday_tpu.contrib.focal_loss import focal_loss  # noqa: F401
+from beforeholiday_tpu.contrib.groupbn import batch_norm_nhwc  # noqa: F401
+from beforeholiday_tpu.contrib.index_mul_2d import index_mul_2d  # noqa: F401
+from beforeholiday_tpu.contrib.peer_memory import halo_exchange_1d  # noqa: F401
+from beforeholiday_tpu.contrib.sparsity import ASP, create_mask  # noqa: F401
+from beforeholiday_tpu.contrib.transducer import (  # noqa: F401
+    transducer_joint,
+    transducer_loss,
+)
 from beforeholiday_tpu.contrib.xentropy import softmax_cross_entropy_loss  # noqa: F401

@@ -1,0 +1,134 @@
+"""Fused ResNet bottleneck + conv epilogue ops
+(ref: apex/contrib/bottleneck/bottleneck.py:74-603 ``Bottleneck``/
+``SpatialBottleneck`` over the cudnn-frontend ``fast_bottleneck`` extension;
+apex/contrib/conv_bias_relu/conv_bias_relu.py:12-56 over
+``fused_conv_bias_relu``).
+
+The CUDA value is epilogue fusion (conv+scale+bias+relu chained without HBM
+round-trips) and, for the spatial variant, halo exchange so the 3x3 conv can
+run on an H-sharded activation. On TPU, XLA fuses conv epilogues natively —
+so ``conv_bias_relu``/``conv_bias_mask_relu`` are contractually-fused
+wrappers (same stance as ops/dense.py) — and the spatial bottleneck maps the
+peer-memory halo to ``ppermute`` (contrib/peer_memory.py).
+
+The bottleneck here is frozen-BN style like the reference kernel: the CUDA
+path folds BN into per-channel (scale, bias) applied in the conv epilogue
+(bottleneck.py:74 computes scale/bias from frozen running stats).
+NHWC layout throughout; weights (KH, KW, Cin, Cout).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.contrib.peer_memory import halo_exchange_1d
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def conv_bias_relu(x, w, bias, stride=1, padding="SAME"):
+    """Fused conv+bias+relu (ref: ConvBiasReLU, conv_bias_relu.py:12)."""
+    y = _conv(x, w, stride, padding) + bias.astype(jnp.float32)
+    return jax.nn.relu(y).astype(x.dtype)
+
+
+def conv_bias(x, w, bias, stride=1, padding="SAME"):
+    """Fused conv+bias (ref: ConvBias)."""
+    return (_conv(x, w, stride, padding) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def conv_bias_mask_relu(x, w, bias, mask, stride=1, padding="SAME"):
+    """Fused conv+bias+mask+relu (ref: ConvBiasMaskReLU — the mask is the
+    backward-relu dropout trick used in bottleneck training)."""
+    y = (_conv(x, w, stride, padding) + bias.astype(jnp.float32)) * mask
+    return jax.nn.relu(y).astype(x.dtype)
+
+
+class BottleneckParams(NamedTuple):
+    """Frozen-BN bottleneck weights: convs + folded per-channel scale/bias
+    (ref: bottleneck.py:74-120 — BN folded into scale/bias at init)."""
+
+    w1: jax.Array  # (1, 1, Cin, Cmid)
+    s1: jax.Array
+    b1: jax.Array
+    w2: jax.Array  # (3, 3, Cmid, Cmid)
+    s2: jax.Array
+    b2: jax.Array
+    w3: jax.Array  # (1, 1, Cmid, Cout)
+    s3: jax.Array
+    b3: jax.Array
+    w_down: Optional[jax.Array] = None  # (1, 1, Cin, Cout) when shapes change
+    s_down: Optional[jax.Array] = None
+    b_down: Optional[jax.Array] = None
+
+
+def init_bottleneck(key, cin, cmid, cout, *, downsample=None) -> BottleneckParams:
+    ks = jax.random.split(key, 4)
+
+    def conv_init(k, kh, kw, ci, co):
+        std = (2.0 / (kh * kw * co)) ** 0.5
+        return jax.random.normal(k, (kh, kw, ci, co), jnp.float32) * std
+
+    if downsample is None:
+        downsample = cin != cout
+    ones = jnp.ones
+    zeros = jnp.zeros
+    return BottleneckParams(
+        conv_init(ks[0], 1, 1, cin, cmid), ones((cmid,)), zeros((cmid,)),
+        conv_init(ks[1], 3, 3, cmid, cmid), ones((cmid,)), zeros((cmid,)),
+        conv_init(ks[2], 1, 1, cmid, cout), ones((cout,)), zeros((cout,)),
+        conv_init(ks[3], 1, 1, cin, cout) if downsample else None,
+        ones((cout,)) if downsample else None,
+        zeros((cout,)) if downsample else None,
+    )
+
+
+def bottleneck(x: jax.Array, p: BottleneckParams, stride: int = 1) -> jax.Array:
+    """conv1x1·scale·bias·relu → conv3x3(stride)·…·relu → conv1x1·…
+    + residual → relu (ref: Bottleneck.forward, bottleneck.py:155-210)."""
+    h = jax.nn.relu(_conv(x, p.w1) * p.s1 + p.b1)
+    h = jax.nn.relu(_conv(h.astype(x.dtype), p.w2, stride) * p.s2 + p.b2)
+    h = _conv(h.astype(x.dtype), p.w3) * p.s3 + p.b3
+    if p.w_down is not None:
+        res = _conv(x, p.w_down, stride) * p.s_down + p.b_down
+    else:
+        res = x.astype(jnp.float32)
+    return jax.nn.relu(h + res).astype(x.dtype)
+
+
+def spatial_bottleneck(
+    x: jax.Array, p: BottleneckParams, *, axis_name: str, stride: int = 1
+) -> jax.Array:
+    """Bottleneck on an H-sharded activation (ref: SpatialBottleneck,
+    bottleneck.py:380-603): the 3x3 conv sees one halo row from each
+    neighbor via the ppermute exchange, everything else is rank-local."""
+    if stride != 1:
+        raise NotImplementedError(
+            "spatial_bottleneck supports stride 1 (strided 3x3 would need "
+            "per-rank phase alignment of the halo rows)"
+        )
+    h = jax.nn.relu(_conv(x, p.w1) * p.s1 + p.b1).astype(x.dtype)
+    h = halo_exchange_1d(h, 1, axis_name=axis_name, dim=1)
+    # halo rows replace SAME zero-padding at the shard seams: convolve with
+    # no padding on H (the exchange provided it), SAME (1,1) on W
+    h = jax.lax.conv_general_dilated(
+        h, p.w2, (1, 1), [(0, 0), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    h = jax.nn.relu(h * p.s2 + p.b2)
+    h = _conv(h.astype(x.dtype), p.w3) * p.s3 + p.b3
+    if p.w_down is not None:
+        res = _conv(x, p.w_down, stride) * p.s_down + p.b_down
+    else:
+        res = x.astype(jnp.float32)
+    return jax.nn.relu(h + res).astype(x.dtype)

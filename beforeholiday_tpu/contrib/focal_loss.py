@@ -64,8 +64,10 @@ def focal_loss(
 
     s = float(label_smoothing)
     if s > 0.0:
-        nn_norm, np_norm = 1.0 - s / K, s / K
-        pn_norm, pp_norm = s - s / K, 1.0 - s + s / K
+        # only the (1 - target) coefficients appear in base: the smoothed CE
+        # -(t*log(sigma) + (1-t)*log(1-sigma)) reduces to (1-t)*p - log(sigma),
+        # with 1-t = nn_norm for negatives and pn_norm for positives
+        nn_norm, pn_norm = 1.0 - s / K, s - s / K
         base = jnp.where(is_pos, pn_norm * p, nn_norm * p)
     else:
         base = jnp.where(is_pos, 0.0, p)

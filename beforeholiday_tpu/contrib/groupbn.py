@@ -1,0 +1,71 @@
+"""Group batch norm, NHWC, with fused add+relu
+(ref: apex/contrib/groupbn/batch_norm.py:135 ``BatchNorm2d_NHWC``, CUDA
+``bnp`` extension with nhwc_batch_norm_kernel.h and CUDA-IPC group sync).
+
+The reference's value: (1) NHWC layout, (2) BN+add+ReLU epilogue fusion,
+(3) statistics synced over a *subgroup* of ``bn_group`` adjacent ranks via
+raw CUDA IPC. On TPU: NHWC is the native conv layout, the epilogue fuses in
+XLA, and the subgroup sync is ``psum(axis_index_groups=...)`` on ICI — so
+this module is the group-wiring + API surface over the repo's
+``sync_batch_norm`` (which already does Welford-equivalent two-pass stats).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from beforeholiday_tpu.parallel.sync_batch_norm import (
+    BatchNormParams,
+    BatchNormState,
+    init_batch_norm,  # noqa: F401  (re-export for parity)
+    sync_batch_norm,
+)
+
+
+def bn_group_ranks(world_size: int, bn_group: int):
+    """Adjacent-rank subgroups of size ``bn_group`` (ref: batch_norm.py's
+    group assignment over local ranks)."""
+    if bn_group <= 1:
+        return None
+    if world_size % bn_group:
+        raise ValueError(f"world {world_size} not divisible by bn_group {bn_group}")
+    return [
+        list(range(g * bn_group, (g + 1) * bn_group))
+        for g in range(world_size // bn_group)
+    ]
+
+
+def batch_norm_nhwc(
+    x: jax.Array,
+    params: BatchNormParams,
+    state: BatchNormState,
+    *,
+    training: bool = True,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+    axis_name: Optional[str] = None,
+    bn_group: int = 1,
+    world_size: Optional[int] = None,
+    residual: Optional[jax.Array] = None,
+    fuse_relu: bool = False,
+) -> Tuple[jax.Array, BatchNormState]:
+    """NHWC (N, H, W, C) group batch norm; ``residual`` is added before the
+    ReLU (the bn_addrelu kernel). With ``bn_group`` > 1 and ``axis_name``
+    bound, stats sync across adjacent-rank subgroups only."""
+    groups = None
+    if bn_group > 1:
+        if axis_name is None:
+            raise ValueError("bn_group > 1 needs axis_name (inside shard_map)")
+        if world_size is None:
+            world_size = jax.lax.axis_size(axis_name)
+        groups = bn_group_ranks(world_size, bn_group)
+    return sync_batch_norm(
+        x, params, state,
+        training=training, momentum=momentum, eps=eps,
+        # bn_group == 1 is local BN (the reference's default: no IPC sync)
+        axis_name=axis_name if bn_group > 1 else None,
+        axis_index_groups=groups,
+        channel_last=True, fuse_relu=fuse_relu, residual=residual,
+    )

@@ -1,0 +1,37 @@
+"""Fused gather-multiply (ref: apex/contrib/index_mul_2d/index_mul_2d.py:5,
+``fused_index_mul_2d`` CUDA extension).
+
+Contract (ref :6-19): ``out[i, :] = in1[idx1[i], :] * in2[i, :]`` for
+2-D in1/in2 and 1-D idx1 — no broadcasting, fp32/fp16. The CUDA kernel fuses
+the gather with the multiply (and the backward's scatter-add of
+``grad_out * in2`` into in1); on TPU XLA fuses ``take + mul`` into one
+kernel and autodiff emits exactly the reference's backward pair
+(scatter-add for in1, gather-multiply for in2), so this is a validated thin
+wrapper, not a Pallas kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1: jax.Array, in2: jax.Array, idx1: jax.Array) -> jax.Array:
+    """out[i] = in1[idx1[i]] * in2[i] (ref: IndexMul2d_.forward)."""
+    if in1.ndim != 2 or in2.ndim != 2:
+        raise RuntimeError("in1 and in2 must be 2-dimension tensor.")
+    if idx1.ndim != 1:
+        raise RuntimeError("idx1 must be 1-dimension tensor.")
+    if in2.shape[0] != idx1.shape[0]:
+        raise RuntimeError(
+            f"in2 rows ({in2.shape[0]}) must match idx1 length ({idx1.shape[0]})"
+        )
+    if in1.dtype != in2.dtype or not jnp.issubdtype(in1.dtype, jnp.floating):
+        raise RuntimeError(
+            "input1's dtype and input2's dtype must be floating and identical"
+        )
+    if in1.shape[1] != in2.shape[1]:
+        raise RuntimeError(
+            f"in1 cols ({in1.shape[1]}) must match in2 cols ({in2.shape[1]})"
+        )
+    return jnp.take(in1, idx1, axis=0) * in2

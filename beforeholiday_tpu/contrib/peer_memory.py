@@ -1,0 +1,53 @@
+"""Peer-memory halo exchange for spatial-parallel convolutions
+(ref: apex/contrib/peer_memory/peer_memory.py:5-35 ``PeerMemoryPool`` +
+peer_halo_exchanger_1d.py; CUDA-IPC + nccl_p2p extensions, SURVEY §2.7).
+
+The reference allocates raw CUDA-IPC buffers so adjacent ranks can write
+each other's halo rows directly. On TPU the equivalent primitive is a pair
+of ``ppermute`` shifts over the spatial mesh axis on ICI — no pool, no IPC
+handles, no registration: the memory-management half of the reference
+collapses into XLA buffer assignment, and only the exchange survives as API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def halo_exchange_1d(
+    x: jax.Array,
+    halo: int,
+    *,
+    axis_name: str,
+    dim: int = 1,
+    wrap: bool = False,
+) -> jax.Array:
+    """Exchange ``halo`` planes with the two neighbors along ``axis_name``.
+
+    x: this rank's spatial shard, halos taken/returned along ``dim``
+    (default 1 = H in NHWC). Returns x extended to ``size + 2*halo`` along
+    ``dim``: [prev rank's last rows | x | next rank's first rows]. Edge ranks
+    get zeros unless ``wrap`` (ref: peer_halo_exchanger_1d's top/btm split —
+    zero-filled boundaries match conv zero padding).
+    """
+    size = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    n = x.shape[dim]
+    if halo <= 0 or halo > n:
+        raise ValueError(f"halo must be in 1..{n}, got {halo}")
+
+    top = jax.lax.slice_in_dim(x, 0, halo, axis=dim)  # my first rows → prev
+    btm = jax.lax.slice_in_dim(x, n - halo, n, axis=dim)  # my last rows → next
+
+    fwd = [(i, (i + 1) % size) for i in range(size)]  # btm rides +1
+    bwd = [(i, (i - 1) % size) for i in range(size)]  # top rides -1
+    from_prev = jax.lax.ppermute(btm, axis_name, fwd)
+    from_next = jax.lax.ppermute(top, axis_name, bwd)
+    if not wrap:
+        zero = jnp.zeros_like(top)
+        from_prev = jnp.where(idx == 0, zero, from_prev)
+        from_next = jnp.where(idx == size - 1, zero, from_next)
+    return jnp.concatenate([from_prev, x, from_next], axis=dim)

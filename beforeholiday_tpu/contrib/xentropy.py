@@ -57,15 +57,15 @@ def _xent_fwd_kernel(smoothing, V, labels_ref, x_ref, loss_ref, lse_ref):
     loss = (1.0 - smoothing) * (lse - tgt) + smoothing * (
         lse - jnp.sum(x, axis=-1, keepdims=True) / V
     )
-    loss_ref[...] = jnp.broadcast_to(loss, loss_ref.shape)  # lane-replicated
-    lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+    loss_ref[...] = loss  # (BR, 1) per-row scalars
+    lse_ref[...] = lse
 
 
 def _xent_bwd_kernel(smoothing, V, labels_ref, x_ref, lse_ref, dy_ref, dx_ref):
     r0 = pl.program_id(0) * _BR
     x = x_ref[...].astype(jnp.float32)
-    lse = lse_ref[:, 0:1]
-    dy = dy_ref[:, 0:1]
+    lse = lse_ref[...]  # (BR, 1)
+    dy = dy_ref[...]
     lab = _row_labels(labels_ref, r0)
     cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     onehot = (cols == lab[:, None]).astype(jnp.float32)
@@ -80,7 +80,7 @@ def _fwd_pallas(logits, labels, smoothing, interpret):
     labp, _ = _pad_rows_util(labels.astype(jnp.int32), _BR)
     grid = xp.shape[0] // _BR
     row = pl.BlockSpec((_BR, V), lambda i, lr: (i, 0))
-    vec = pl.BlockSpec((_BR, 128), lambda i, lr: (i, 0))
+    vec = pl.BlockSpec((_BR, 1), lambda i, lr: (i, 0))
     loss, lse = pl.pallas_call(
         functools.partial(_xent_fwd_kernel, smoothing, V),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -88,8 +88,8 @@ def _fwd_pallas(logits, labels, smoothing, interpret):
             out_specs=[vec, vec],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((xp.shape[0], 128), jnp.float32),
-            jax.ShapeDtypeStruct((xp.shape[0], 128), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], 1), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], 1), jnp.float32),
         ],
         interpret=interpret,
     )(labp, xp)
@@ -101,11 +101,13 @@ def _bwd_pallas(logits, labels, lse, dy, smoothing, interpret):
     xp, _ = _pad_rows_util(logits, _BR)
     labp, _ = _pad_rows_util(labels.astype(jnp.int32), _BR)
     rows = xp.shape[0]
-    lse2, _ = _pad_rows_util(jnp.broadcast_to(lse[:, None], (N, 128)), _BR)
-    dy2, _ = _pad_rows_util(jnp.broadcast_to(dy[:, None], (N, 128)), _BR)
+    # per-row scalars ride as (N, 1) operands — the (BR, 1) block is legal
+    # (lane dim equals the array dim) and carries 4 bytes/row, not 512
+    lse2, _ = _pad_rows_util(lse[:, None].astype(jnp.float32), _BR)
+    dy2, _ = _pad_rows_util(dy[:, None].astype(jnp.float32), _BR)
     grid = rows // _BR
     row = pl.BlockSpec((_BR, V), lambda i, lr: (i, 0))
-    vec = pl.BlockSpec((_BR, 128), lambda i, lr: (i, 0))
+    vec = pl.BlockSpec((_BR, 1), lambda i, lr: (i, 0))
     dx = pl.pallas_call(
         functools.partial(_xent_bwd_kernel, smoothing, V),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -122,7 +124,6 @@ def _fwd_jnp(logits, labels, smoothing):
     x = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(x, axis=-1)
     tgt = jnp.take_along_axis(x, labels[:, None], axis=-1)[:, 0]
-    V = x.shape[-1]
     loss = (1.0 - smoothing) * (lse - tgt) + smoothing * (lse - jnp.mean(x, axis=-1))
     return loss, lse
 

@@ -37,6 +37,21 @@ from ._pallas_util import interpret_default as _interpret_default
 BLOCK_ROWS = 256
 BLOCK_ELEMS = BLOCK_ROWS * LANES
 
+# Large arenas step through bigger tiles: per-grid-step overhead (~µs on a
+# v5e) dominates 128 KiB blocks on multi-M-element arenas. The largest tile
+# in the ladder that DIVIDES the arena's row count is used (rows are always
+# a multiple of BLOCK_ROWS via arena.TILE) — dividing exactly avoids any
+# pad-copy of the arena; 1024 rows (512 KiB fp32) keeps the widest kernel
+# (LAMB, ~8 operands, double-buffered) inside the ~16 MiB VMEM budget.
+_ROW_LADDER = (1024, 512, 256)
+
+
+def _choose_rows(rows: int) -> int:
+    for cand in _ROW_LADDER:
+        if rows % cand == 0:
+            return cand
+    return BLOCK_ROWS
+
 
 def ew_call(
     kernel,
@@ -59,7 +74,8 @@ def ew_call(
     n = arrays[0].shape[0]
     assert n % BLOCK_ELEMS == 0, f"arena length {n} not padded to {BLOCK_ELEMS}"
     rows = n // LANES
-    grid = rows // BLOCK_ROWS
+    br = _choose_rows(rows)
+    grid = rows // br
 
     n_scal = max(len(scalars), 1)
     scal = jnp.asarray(list(scalars) or [0.0], dtype=jnp.float32).reshape(1, n_scal)
@@ -69,7 +85,7 @@ def ew_call(
         fi = jnp.asarray(found_inf, dtype=jnp.float32).reshape(1, 1)
 
     smem_spec = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0), memory_space=pltpu.SMEM)
-    vmem_spec = pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    vmem_spec = pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
 
     in_specs = [smem_spec((1, n_scal)), smem_spec((1, 1))]
     in_specs += [vmem_spec] * len(arrays)
@@ -183,9 +199,10 @@ def l2norm_sq(x_flat, *, interpret=None):
     n = x_flat.shape[0]
     assert n % BLOCK_ELEMS == 0, f"arena length {n} not padded to {BLOCK_ELEMS}"
     rows = n // LANES
-    grid = rows // BLOCK_ROWS
+    br = _choose_rows(rows)
+    grid = rows // br
     smem_spec = lambda: pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
-    vmem_spec = pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    vmem_spec = pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
     acc, flag = pl.pallas_call(
         _l2norm_kernel,
         grid=(grid,),
@@ -199,7 +216,8 @@ def l2norm_sq(x_flat, *, interpret=None):
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ],
         interpret=interpret,
-    )(jnp.zeros((1, 1), jnp.float32), jnp.zeros((1, 1), jnp.float32), x_flat.reshape(rows, LANES))
+    )(jnp.zeros((1, 1), jnp.float32), jnp.zeros((1, 1), jnp.float32),
+      x_flat.reshape(rows, LANES))
     return acc[0, 0], flag[0, 0] != 0
 
 

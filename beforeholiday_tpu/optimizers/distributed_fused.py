@@ -62,15 +62,25 @@ class _DistributedFused:
         shard = _shard_len(spec.padded_total, world)
         return leaves, treedef, spec, shard
 
-    def init(self, params):
-        """Local fp32 state shard. Must run inside shard_map (data axis bound)."""
-        leaves, treedef, spec, shard = self._arena_layout(params)
+    def _shard_of(self, leaves, shard):
+        """Flatten per-tensor leaves into the fp32 arena and slice THIS rank's
+        TILE-aligned shard — the one layout used by init/load_state_dict."""
         flat, _ = flatten(leaves, dtype=jnp.float32)
         flat = _pad_to(flat, shard * self._world())
         rank = jax.lax.axis_index(self.axis_name)
-        master = jax.lax.dynamic_slice_in_dim(flat, rank * shard, shard)
+        return jax.lax.dynamic_slice_in_dim(flat, rank * shard, shard)
+
+    def _gather_full(self, shard_arr, spec):
+        """all_gather a state shard back into full per-tensor pieces — the one
+        inverse used by _gather_params/state_dict."""
+        full = jax.lax.all_gather(shard_arr, self.axis_name, axis=0, tiled=True)
+        return unflatten(full[: spec.padded_total], spec)
+
+    def init(self, params):
+        """Local fp32 state shard. Must run inside shard_map (data axis bound)."""
+        leaves, treedef, spec, shard = self._arena_layout(params)
         state = {
-            "master": master,
+            "master": self._shard_of(leaves, shard),
             "step": jnp.zeros((), jnp.int32),
         }
         for key in self._state_keys():
@@ -89,12 +99,10 @@ class _DistributedFused:
         return g_shard
 
     def _gather_params(self, master_shard, params, spec):
-        full = jax.lax.all_gather(master_shard, self.axis_name, axis=0, tiled=True)
-        full = full[: spec.padded_total]
         leaves = jax.tree_util.tree_leaves(params)
         new_leaves = [
             piece.astype(leaf.dtype)
-            for piece, leaf in zip(unflatten(full, spec), leaves)
+            for piece, leaf in zip(self._gather_full(master_shard, spec), leaves)
         ]
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params), new_leaves
@@ -106,6 +114,38 @@ class _DistributedFused:
             local_bad | (jnp.asarray(found_inf) != 0)
         )
         return jax.lax.pmax(flag.astype(jnp.float32), self.axis_name) != 0
+
+    # -- checkpointing (ref: distributed_fused_adam.py:1123-1150
+    # ``state_dict(gather_on_root=True)`` + ``load_state_dict``) --------------
+
+    def state_dict(self, params, state, *, gather_on_root: bool = True):
+        """Checkpointable optimizer state. Runs INSIDE shard_map.
+
+        ``gather_on_root=True`` all-gathers each state shard into full
+        per-tensor pytrees (fp32, shaped like ``params``) — the reference
+        gathers to rank 0 for ``torch.save``; under SPMD the gathered copy is
+        identical on every rank, which is strictly more convenient (any host
+        can save). ``False`` returns the local shard verbatim (the
+        reference's shard-local checkpoint mode)."""
+        if not gather_on_root:
+            return dict(state)
+        _, treedef, spec, _ = self._arena_layout(params)
+        out = {"step": state["step"]}
+        for key in ("master",) + self._state_keys():
+            out[key] = jax.tree_util.tree_unflatten(
+                treedef, self._gather_full(state[key], spec)
+            )
+        return out
+
+    def load_state_dict(self, params, state_dict):
+        """Inverse of ``state_dict(gather_on_root=True)``: re-shard the full
+        per-tensor state onto this rank. Runs INSIDE shard_map."""
+        _, _, _, shard = self._arena_layout(params)
+        state = {"step": jnp.asarray(state_dict["step"], jnp.int32)}
+        for key in ("master",) + self._state_keys():
+            kleaves = jax.tree_util.tree_leaves(state_dict[key])
+            state[key] = self._shard_of(kleaves, shard)
+        return state
 
 
 class DistributedFusedAdam(_DistributedFused):

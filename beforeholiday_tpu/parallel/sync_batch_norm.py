@@ -50,8 +50,10 @@ def sync_batch_norm(
     momentum: float = 0.1,
     eps: float = 1e-5,
     axis_name: Optional[str] = None,
+    axis_index_groups=None,
     channel_last: bool = False,
     fuse_relu: bool = False,
+    residual: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, BatchNormState]:
     """Apply (Sync)BatchNorm. Returns (y, new_state).
 
@@ -59,7 +61,11 @@ def sync_batch_norm(
     reference's NHWC path). With ``axis_name`` set (inside shard_map), batch
     statistics are merged across that axis; without it this is plain fused BN
     (the reference falls back the same way when world_size == 1).
-    ``fuse_relu`` matches the kernel's fused-ReLU epilogue (welford.cu:686).
+    ``fuse_relu`` matches the kernel's fused-ReLU epilogue (welford.cu:686);
+    ``residual`` is added before the ReLU (the bn_addrelu fusion the contrib
+    groupbn kernels provide, ref: apex/contrib/groupbn/batch_norm.py:135).
+    ``axis_index_groups`` restricts the stat sync to subgroups of the axis
+    (contrib groupbn's ``bn_group``), passed straight to ``psum``.
     """
     c_axis = x.ndim - 1 if channel_last else 1
     reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
@@ -75,12 +81,13 @@ def sync_batch_norm(
         count = jnp.float32(math.prod(x.shape[i] for i in reduce_axes))
         local_sum = jnp.sum(xf, axis=reduce_axes)
         if axis_name is not None:
-            count = jax.lax.psum(count, axis_name)
-            mean = jax.lax.psum(local_sum, axis_name) / count
+            groups = axis_index_groups
+            count = jax.lax.psum(count, axis_name, axis_index_groups=groups)
+            mean = jax.lax.psum(local_sum, axis_name, axis_index_groups=groups) / count
             centered_sq = jnp.sum(
                 jnp.square(xf - mean.reshape(shape_bc)), axis=reduce_axes
             )
-            var = jax.lax.psum(centered_sq, axis_name) / count
+            var = jax.lax.psum(centered_sq, axis_name, axis_index_groups=groups) / count
         else:
             mean = local_sum / count
             var = jnp.mean(jnp.square(xf - mean.reshape(shape_bc)), axis=reduce_axes)
@@ -99,6 +106,8 @@ def sync_batch_norm(
     y = y * params.scale.astype(jnp.float32).reshape(shape_bc) + params.bias.astype(
         jnp.float32
     ).reshape(shape_bc)
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
     if fuse_relu:
         y = jax.nn.relu(y)
     return y.astype(x.dtype), new_state

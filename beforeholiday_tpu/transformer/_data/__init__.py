@@ -1,0 +1,6 @@
+"""Megatron pretraining batch samplers (ref: apex/transformer/_data/)."""
+
+from beforeholiday_tpu.transformer._data.batchsampler import (  # noqa: F401
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
