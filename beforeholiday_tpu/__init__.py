@@ -15,8 +15,13 @@ capability surface of NVIDIA Apex (reference: /root/reference):
   (ref: apex/parallel/).
 - ``beforeholiday_tpu.transformer`` — Megatron-style tensor/sequence/pipeline parallelism on a
   GSPMD mesh (ref: apex/transformer/).
-- ``beforeholiday_tpu.contrib``     — flash attention, fused losses, sparsity, etc.
-  (ref: apex/contrib/).
+- ``beforeholiday_tpu.contrib``     — flash attention, fused losses, sparsity, transducer,
+  group BN, halo exchange, (spatial) bottleneck (ref: apex/contrib/).
+- ``beforeholiday_tpu.models``      — ResNet family for the flagship ImageNet recipe
+  (ref: examples/imagenet/).
+- ``beforeholiday_tpu.rnn``         — LSTM/GRU/ReLU/Tanh/mLSTM cells (ref: apex/RNN/).
+- ``beforeholiday_tpu.fp16_utils``  — the deprecated explicit master-weight API
+  (ref: apex/fp16_utils/).
 
 Unlike the reference, which grafts CUDA kernels onto PyTorch via monkey-patching,
 this framework is functional and mesh-first: precision policies are dtype policies
@@ -25,9 +30,11 @@ collective is a `jax.lax` collective over named mesh axes carried on ICI/DCN.
 """
 
 from beforeholiday_tpu import amp
+from beforeholiday_tpu import fp16_utils
 from beforeholiday_tpu import ops
 from beforeholiday_tpu import optimizers
 from beforeholiday_tpu import parallel
+from beforeholiday_tpu import rnn
 from beforeholiday_tpu import transformer
 from beforeholiday_tpu.utils.logging import get_logger
 
@@ -35,9 +42,11 @@ __version__ = "0.1.0"
 
 __all__ = [
     "amp",
+    "fp16_utils",
     "ops",
     "optimizers",
     "parallel",
+    "rnn",
     "transformer",
     "get_logger",
     "__version__",

@@ -28,29 +28,32 @@ from beforeholiday_tpu.contrib.peer_memory import halo_exchange_1d
 
 
 def _conv(x, w, stride=1, padding="SAME"):
+    # weights cast to x.dtype, no preferred_element_type: its VJP is
+    # undefined for fp16 inputs in current jax; XLA's MXU lowering still
+    # accumulates low-precision convs in fp32 internally
     return jax.lax.conv_general_dilated(
-        x, w, (stride, stride), padding,
+        x, w.astype(x.dtype), (stride, stride), padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32,
     )
 
 
 def conv_bias_relu(x, w, bias, stride=1, padding="SAME"):
     """Fused conv+bias+relu (ref: ConvBiasReLU, conv_bias_relu.py:12)."""
-    y = _conv(x, w, stride, padding) + bias.astype(jnp.float32)
+    y = _conv(x, w, stride, padding).astype(jnp.float32) + bias.astype(jnp.float32)
     return jax.nn.relu(y).astype(x.dtype)
 
 
 def conv_bias(x, w, bias, stride=1, padding="SAME"):
     """Fused conv+bias (ref: ConvBias)."""
-    return (_conv(x, w, stride, padding) + bias.astype(jnp.float32)).astype(x.dtype)
+    y = _conv(x, w, stride, padding).astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
 
 
 def conv_bias_mask_relu(x, w, bias, mask, stride=1, padding="SAME"):
     """Fused conv+bias+mask+relu (ref: ConvBiasMaskReLU — the mask is the
     backward-relu dropout trick used in bottleneck training)."""
-    y = (_conv(x, w, stride, padding) + bias.astype(jnp.float32)) * mask
-    return jax.nn.relu(y).astype(x.dtype)
+    y = _conv(x, w, stride, padding).astype(jnp.float32) + bias.astype(jnp.float32)
+    return jax.nn.relu(y * mask).astype(x.dtype)
 
 
 class BottleneckParams(NamedTuple):
@@ -95,11 +98,13 @@ def init_bottleneck(key, cin, cmid, cout, *, downsample=None) -> BottleneckParam
 def bottleneck(x: jax.Array, p: BottleneckParams, stride: int = 1) -> jax.Array:
     """conv1x1·scale·bias·relu → conv3x3(stride)·…·relu → conv1x1·…
     + residual → relu (ref: Bottleneck.forward, bottleneck.py:155-210)."""
-    h = jax.nn.relu(_conv(x, p.w1) * p.s1 + p.b1)
-    h = jax.nn.relu(_conv(h.astype(x.dtype), p.w2, stride) * p.s2 + p.b2)
-    h = _conv(h.astype(x.dtype), p.w3) * p.s3 + p.b3
+    h = jax.nn.relu(_conv(x, p.w1).astype(jnp.float32) * p.s1 + p.b1)
+    h = jax.nn.relu(
+        _conv(h.astype(x.dtype), p.w2, stride).astype(jnp.float32) * p.s2 + p.b2
+    )
+    h = _conv(h.astype(x.dtype), p.w3).astype(jnp.float32) * p.s3 + p.b3
     if p.w_down is not None:
-        res = _conv(x, p.w_down, stride) * p.s_down + p.b_down
+        res = _conv(x, p.w_down, stride).astype(jnp.float32) * p.s_down + p.b_down
     else:
         res = x.astype(jnp.float32)
     return jax.nn.relu(h + res).astype(x.dtype)
@@ -116,19 +121,18 @@ def spatial_bottleneck(
             "spatial_bottleneck supports stride 1 (strided 3x3 would need "
             "per-rank phase alignment of the halo rows)"
         )
-    h = jax.nn.relu(_conv(x, p.w1) * p.s1 + p.b1).astype(x.dtype)
+    h = jax.nn.relu(_conv(x, p.w1).astype(jnp.float32) * p.s1 + p.b1).astype(x.dtype)
     h = halo_exchange_1d(h, 1, axis_name=axis_name, dim=1)
     # halo rows replace SAME zero-padding at the shard seams: convolve with
     # no padding on H (the exchange provided it), SAME (1,1) on W
     h = jax.lax.conv_general_dilated(
-        h, p.w2, (1, 1), [(0, 0), (1, 1)],
+        h, p.w2.astype(h.dtype), (1, 1), [(0, 0), (1, 1)],
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32,
     )
-    h = jax.nn.relu(h * p.s2 + p.b2)
-    h = _conv(h.astype(x.dtype), p.w3) * p.s3 + p.b3
+    h = jax.nn.relu(h.astype(jnp.float32) * p.s2 + p.b2)
+    h = _conv(h.astype(x.dtype), p.w3).astype(jnp.float32) * p.s3 + p.b3
     if p.w_down is not None:
-        res = _conv(x, p.w_down, stride) * p.s_down + p.b_down
+        res = _conv(x, p.w_down, stride).astype(jnp.float32) * p.s_down + p.b_down
     else:
         res = x.astype(jnp.float32)
     return jax.nn.relu(h + res).astype(x.dtype)

@@ -1,0 +1,145 @@
+"""fp16_utils — the deprecated explicit mixed-precision API
+(ref: apex/fp16_utils/fp16util.py, loss_scaler.py, fp16_optimizer.py:13
+``FP16_Optimizer``).
+
+amp (O2/O5) subsumed this surface in the reference; it survives for scripts
+written against the explicit master-weight flow. Here the same helpers are
+thin functional delegates to the modern machinery (``amp.LossScaler``,
+``MasterWeights``, the multi-tensor kernels) — one implementation, two API
+vintages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.amp.frontend import _default_keep_fp32
+from beforeholiday_tpu.amp.scaler import LossScaler
+from beforeholiday_tpu.ops._autocast import cast_floats
+
+__all__ = [
+    "network_to_half",
+    "prep_param_lists",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "FP16_Optimizer",
+]
+
+
+def network_to_half(params, *, keep_fp32_mask=None):
+    """Cast floating params to fp16, norm/BN params kept fp32
+    (ref: fp16util.py ``network_to_half`` + ``BN_convert_float``)."""
+    keep = keep_fp32_mask or _default_keep_fp32
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = [
+        leaf.astype(jnp.float32)
+        if (jnp.issubdtype(leaf.dtype, jnp.floating) and keep(path))
+        else (
+            leaf.astype(jnp.float16)
+            if jnp.issubdtype(leaf.dtype, jnp.floating)
+            else leaf
+        )
+        for path, leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def prep_param_lists(params) -> Tuple[Any, Any]:
+    """(model half params, fp32 master copies)
+    (ref: fp16util.py ``prep_param_lists``)."""
+    return params, cast_floats(params, jnp.float32)
+
+
+def master_params_to_model_params(model_params, master_params):
+    """Copy fp32 masters into the model's storage dtypes
+    (ref: fp16util.py ``master_params_to_model_params``)."""
+    return jax.tree.map(
+        lambda mp, m: m.astype(mp.dtype) if hasattr(mp, "dtype") else m,
+        model_params, master_params,
+    )
+
+
+def model_grads_to_master_grads(grads):
+    """fp16 grads -> fp32 (ref: fp16util.py ``model_grads_to_master_grads``)."""
+    return cast_floats(grads, jnp.float32)
+
+
+class FP16_Optimizer:
+    """Explicit master-weight optimizer wrapper
+    (ref: apex/fp16_utils/fp16_optimizer.py:13 — ``backward()`` +
+    ``update_master_grads`` + ``clip_master_grads`` + ``step``).
+
+    Functional shape: ``scaled_loss(loss, state)`` scales, ``step(params,
+    scaled_grads, state)`` unscales into fp32 masters, detects overflow,
+    skip-steps, updates the scale, and casts masters back to the model
+    dtype — the torch wrapper's whole backward-to-step dance in one jittable
+    call.
+    """
+
+    def __init__(
+        self,
+        optimizer,
+        static_loss_scale: float = 1.0,
+        dynamic_loss_scale: bool = False,
+        *,
+        clip_grad_norm: Optional[float] = None,
+    ):
+        from beforeholiday_tpu.amp.frontend import MasterWeights
+
+        # the modern machinery IS the implementation: MasterWeights owns the
+        # unscale->update->cast-back dance, this class only maps the legacy
+        # API shape onto it
+        self._mw = MasterWeights(optimizer)
+        self.optimizer = optimizer
+        self.scaler = LossScaler(
+            loss_scale="dynamic" if dynamic_loss_scale else static_loss_scale
+        )
+        self.clip = clip_grad_norm
+
+    def init(self, params):
+        mw_state = self._mw.init(params)
+        return {
+            "master": mw_state["master"],
+            "opt": mw_state["inner"],
+            "scaler": self.scaler.init(),
+        }
+
+    def scale_loss(self, loss, state):
+        """loss * current scale (the ``backward(loss)`` entry point)."""
+        return self.scaler.scale_loss(loss, state["scaler"])
+
+    def step(self, params, grads, state, *, lr=None):
+        """Consume grads of the SCALED loss. Returns (params, state)."""
+        grads32, found_inf = self.scaler.unscale(grads, state["scaler"])
+        if self.clip is not None:
+            from beforeholiday_tpu.contrib.clip_grad import clip_grad_norm_
+
+            grads32, _ = clip_grad_norm_(grads32, self.clip)
+        kw = {} if lr is None else {"lr": lr}
+        new_params, mw_state = self._mw.step(
+            params, grads32, {"inner": state["opt"], "master": state["master"]},
+            found_inf=found_inf, **kw,
+        )
+        return new_params, {
+            "master": mw_state["master"],
+            "opt": mw_state["inner"],
+            "scaler": self.scaler.update(state["scaler"], found_inf),
+        }
+
+    # legacy state_dict surface (ref: fp16_optimizer.py:209-270)
+    def state_dict(self, state):
+        return {
+            "loss_scaler": self.scaler.state_dict(state["scaler"]),
+            "optimizer_state_dict": state["opt"],
+            "fp32_from_fp16": state["master"],
+        }
+
+    def load_state_dict(self, state_dict):
+        return {
+            "master": state_dict["fp32_from_fp16"],
+            "opt": state_dict["optimizer_state_dict"],
+            "scaler": self.scaler.load_state_dict(state_dict["loss_scaler"]),
+        }

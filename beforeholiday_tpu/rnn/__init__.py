@@ -1,0 +1,212 @@
+"""apex.RNN — LSTM/GRU/ReLU/Tanh/mLSTM built from cells
+(ref: apex/RNN/RNNBackend.py:232 RNNCell + stackedRNN/bidirectionalRNN,
+models.py:19-52 factory functions, cells.py mLSTMCell).
+
+The reference composes torch cell modules with per-step python loops and
+mutable hidden state. TPU-native: cells are pure step functions closed over
+a params dict, layers run under ``lax.scan`` over time (one compiled step
+per layer), stacking is a python loop over layers (static depth),
+bidirectional runs the reversed scan and concatenates — the
+``toRNNBackend`` composition as function composition.
+
+API: ``make_rnn(kind, ...)`` returns ``(init, apply)`` with
+``apply(params, x, hidden=None) -> (output, last_hidden)`` over seq-first
+``x (T, B, input)`` — the reference's default layout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["make_rnn", "LSTM", "GRU", "ReLU", "Tanh", "mLSTM"]
+
+
+def _uniform(key, shape, bound):
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+
+def _linear_params(key, gates, input_size, hidden_size, bias):
+    """w_ih (G*H, I), w_hh (G*H, H), biases — torch RNNCell layout with
+    uniform(-1/sqrt(H), 1/sqrt(H)) init (ref: RNNBackend.py reset_parameters)."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    bound = 1.0 / math.sqrt(hidden_size)
+    p = {
+        "w_ih": _uniform(k1, (gates * hidden_size, input_size), bound),
+        "w_hh": _uniform(k2, (gates * hidden_size, hidden_size), bound),
+    }
+    if bias:
+        p["b_ih"] = _uniform(k3, (gates * hidden_size,), bound)
+        p["b_hh"] = _uniform(k4, (gates * hidden_size,), bound)
+    return p
+
+
+def _gates(p, x, h):
+    g = x @ p["w_ih"].T + h @ p["w_hh"].T
+    if "b_ih" in p:
+        g = g + p["b_ih"] + p["b_hh"]
+    return g
+
+
+def _lstm_step(p, x, hidden):
+    h, c = hidden
+    i, f, g, o = jnp.split(_gates(p, x, h), 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return (h, c), h
+
+
+def _gru_step(p, x, hidden):
+    (h,) = hidden
+    # torch GRU: n = tanh(W_in x + b_in + r * (W_hn h + b_hn))
+    gi = x @ p["w_ih"].T + (p["b_ih"] if "b_ih" in p else 0.0)
+    gh = h @ p["w_hh"].T + (p["b_hh"] if "b_hh" in p else 0.0)
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    h = (1.0 - z) * n + z * h
+    return (h,), h
+
+
+def _relu_step(p, x, hidden):
+    (h,) = hidden
+    h = jax.nn.relu(_gates(p, x, h))
+    return (h,), h
+
+
+def _tanh_step(p, x, hidden):
+    (h,) = hidden
+    h = jnp.tanh(_gates(p, x, h))
+    return (h,), h
+
+
+def _mlstm_step(p, x, hidden):
+    """Multiplicative LSTM (ref: cells.py mLSTMCell): the hidden fed to the
+    gates is m = (W_mih x) * (W_mhh h)."""
+    h, c = hidden
+    m = (x @ p["w_mih"].T) * (h @ p["w_mhh"].T)
+    i, f, g, o = jnp.split(_gates(p, x, m), 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return (h, c), h
+
+
+_CELLS = {
+    "lstm": (_lstm_step, 4, 2),
+    "gru": (_gru_step, 3, 1),
+    "relu": (_relu_step, 1, 1),
+    "tanh": (_tanh_step, 1, 1),
+    "mlstm": (_mlstm_step, 4, 2),
+}
+
+
+def make_rnn(
+    kind: str,
+    input_size: int,
+    hidden_size: int,
+    num_layers: int = 1,
+    *,
+    bias: bool = True,
+    bidirectional: bool = False,
+    output_size: Optional[int] = None,
+):
+    """Build ``(init, apply)`` for a stacked RNN (ref: models.py factories).
+
+    ``apply(params, x, hidden=None)``: x (T, B, input) → (output
+    (T, B, H or 2H), hidden) where hidden is a list of per-layer state
+    tuples. ``output_size`` adds the reference's output projection.
+    """
+    if kind not in _CELLS:
+        raise ValueError(f"unknown RNN kind {kind!r}; have {sorted(_CELLS)}")
+    step_fn, gate_mult, n_state = _CELLS[kind]
+    n_dir = 2 if bidirectional else 1
+
+    def init(key):
+        params = []
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * n_dir
+            dirs = []
+            for _ in range(n_dir):
+                key, sub = jax.random.split(key)
+                p = _linear_params(sub, gate_mult, in_size, hidden_size, bias)
+                if kind == "mlstm":
+                    key, k1, k2 = jax.random.split(key, 3)
+                    bound = 1.0 / math.sqrt(hidden_size)
+                    p["w_mih"] = _uniform(k1, (hidden_size, in_size), bound)
+                    p["w_mhh"] = _uniform(k2, (hidden_size, hidden_size), bound)
+                dirs.append(p)
+            params.append(dirs)
+        out = {"layers": params}
+        if output_size is not None:
+            key, sub = jax.random.split(key)
+            out["w_out"] = _uniform(
+                sub, (output_size, hidden_size * n_dir), 1.0 / math.sqrt(hidden_size)
+            )
+        return out
+
+    def _zero_state(batch):
+        return tuple(jnp.zeros((batch, hidden_size)) for _ in range(n_state))
+
+    def _run_dir(p, x, h0, reverse):
+        if reverse:
+            x = x[::-1]
+
+        def body(hidden, xt):
+            return step_fn(p, xt, hidden)
+
+        last, ys = jax.lax.scan(body, h0, x)
+        if reverse:
+            ys = ys[::-1]
+        return ys, last
+
+    def apply(params, x, hidden=None):
+        T, B = x.shape[:2]
+        if hidden is None:
+            hidden = [
+                [_zero_state(B) for _ in range(n_dir)] for _ in range(num_layers)
+            ]
+        out = x
+        new_hidden = []
+        for layer, dirs in enumerate(params["layers"]):
+            ys, lasts = [], []
+            for d, p in enumerate(dirs):
+                y, last = _run_dir(p, out, tuple(hidden[layer][d]), d == 1)
+                ys.append(y)
+                lasts.append(last)
+            out = jnp.concatenate(ys, axis=-1) if n_dir == 2 else ys[0]
+            new_hidden.append(lasts)
+        if "w_out" in params:
+            out = out @ params["w_out"].T
+        return out, new_hidden
+
+    return init, apply
+
+
+def LSTM(input_size, hidden_size, num_layers, **kw):
+    """ref: models.py:19."""
+    return make_rnn("lstm", input_size, hidden_size, num_layers, **kw)
+
+
+def GRU(input_size, hidden_size, num_layers, **kw):
+    """ref: models.py:26."""
+    return make_rnn("gru", input_size, hidden_size, num_layers, **kw)
+
+
+def ReLU(input_size, hidden_size, num_layers, **kw):
+    """ref: models.py:33."""
+    return make_rnn("relu", input_size, hidden_size, num_layers, **kw)
+
+
+def Tanh(input_size, hidden_size, num_layers, **kw):
+    """ref: models.py:40."""
+    return make_rnn("tanh", input_size, hidden_size, num_layers, **kw)
+
+
+def mLSTM(input_size, hidden_size, num_layers, **kw):
+    """ref: models.py:47."""
+    return make_rnn("mlstm", input_size, hidden_size, num_layers, **kw)

@@ -1,0 +1,46 @@
+"""Shared helpers for the in-repo test models (GPT, BERT)."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS, TENSOR_AXIS
+
+
+def constrain(x, spec: P):
+    """Apply a sharding constraint iff the global mesh is initialized.
+
+    Keeps the models runnable single-chip with no mesh (entry()) while giving
+    GSPMD full layout information under ``initialize_model_parallel``.
+    """
+    from beforeholiday_tpu.parallel import parallel_state as ps
+    from jax.sharding import NamedSharding
+
+    if ps.model_parallel_is_initialized():
+        return jax.lax.with_sharding_constraint(x, NamedSharding(ps.get_mesh(), spec))
+    return x
+
+
+def residual_spec(cfg) -> P:
+    """Sharding of the residual stream between transformer blocks.
+
+    With ``cfg.sequence_parallel`` the residual lives scattered along
+    sequence over the ``tensor`` axis (ref: mappings.py:205-260 — the
+    scatter/gather/reduce-scatter SP region ops). Under GSPMD the constraint
+    alone makes XLA insert the all-gather before the column-parallel GEMMs
+    and the reduce-scatter after the row-parallel ones
+    (ref: layers.py:293-306, 355-363 does this by hand).
+    """
+    if cfg.sequence_parallel:
+        return P(DATA_AXIS, TENSOR_AXIS, None)
+    return P(DATA_AXIS, None, None)
+
+
+def layernorm(x, scale, bias):
+    """Fused LN; params may be fp32 under an amp policy while activations are
+    bf16 — passed through uncast: the kernel computes in fp32 internally, so
+    fp32 gamma/beta keep full precision (keep_batchnorm_fp32 intact)."""
+    from beforeholiday_tpu.ops import fused_layer_norm
+
+    return fused_layer_norm(x, scale, bias)

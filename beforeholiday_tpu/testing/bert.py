@@ -31,6 +31,11 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS, TENSOR_AXIS
+from beforeholiday_tpu.testing._model_utils import (
+    constrain as _constrain,
+    layernorm as _layernorm,
+    residual_spec as _residual_spec,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -140,26 +145,6 @@ def param_specs(cfg: BertConfig) -> dict:
         "nsp_b": P(None),
     }
 
-
-def _constrain(x, spec: P):
-    from beforeholiday_tpu.parallel import parallel_state as ps
-    from jax.sharding import NamedSharding
-
-    if ps.model_parallel_is_initialized():
-        return jax.lax.with_sharding_constraint(x, NamedSharding(ps.get_mesh(), spec))
-    return x
-
-
-def _residual_spec(cfg: BertConfig) -> P:
-    if cfg.sequence_parallel:
-        return P(DATA_AXIS, TENSOR_AXIS, None)
-    return P(DATA_AXIS, None, None)
-
-
-def _layernorm(x, scale, bias):
-    from beforeholiday_tpu.ops import fused_layer_norm
-
-    return fused_layer_norm(x, scale, bias)
 
 
 def _attention(cfg: BertConfig, q, k, v, lens):

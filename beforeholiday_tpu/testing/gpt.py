@@ -28,6 +28,11 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS, TENSOR_AXIS
+from beforeholiday_tpu.testing._model_utils import (
+    constrain as _constrain,
+    layernorm as _layernorm,
+    residual_spec as _residual_spec,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,43 +123,6 @@ def param_specs(cfg: GPTConfig) -> dict:
         "lnf_bias": P(None),
     }
 
-
-def _constrain(x, spec: P):
-    """Apply a sharding constraint iff the global mesh is initialized.
-
-    Keeps the model runnable single-chip with no mesh (entry()) while giving
-    GSPMD full layout information under ``initialize_model_parallel``.
-    """
-    from beforeholiday_tpu.parallel import parallel_state as ps
-    from jax.sharding import NamedSharding
-
-    if ps.model_parallel_is_initialized():
-        return jax.lax.with_sharding_constraint(x, NamedSharding(ps.get_mesh(), spec))
-    return x
-
-
-def _residual_spec(cfg: GPTConfig) -> P:
-    """Sharding of the residual stream between blocks.
-
-    With sequence_parallel the residual lives scattered along sequence over
-    the ``tensor`` axis (ref: mappings.py:205-260 — the scatter/gather/
-    reduce-scatter SP region ops). Under GSPMD the constraint alone makes XLA
-    insert the all-gather before the column-parallel GEMMs and the
-    reduce-scatter after the row-parallel ones (ref: layers.py:293-306,
-    355-363 does this by hand).
-    """
-    if cfg.sequence_parallel:
-        return P(DATA_AXIS, TENSOR_AXIS, None)
-    return P(DATA_AXIS, None, None)
-
-
-def _layernorm(x, scale, bias):
-    # params may be fp32 under an amp policy while activations are bf16 —
-    # passed through uncast: the fused kernel computes in fp32 internally, so
-    # fp32 gamma/beta keep their full precision (keep_batchnorm_fp32 intact)
-    from beforeholiday_tpu.ops import fused_layer_norm
-
-    return fused_layer_norm(x, scale, bias)
 
 
 def _block(cfg: GPTConfig, x, lp):

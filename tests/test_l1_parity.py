@@ -20,8 +20,18 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "examples", "imagenet"))
+def _load_imagenet():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "examples", "imagenet", "main_amp.py")
+    if "imagenet_main_amp" in sys.modules:
+        return sys.modules["imagenet_main_amp"]
+    spec = importlib.util.spec_from_file_location("imagenet_main_amp", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["imagenet_main_amp"] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 from beforeholiday_tpu import amp
 from beforeholiday_tpu.models import resnet
@@ -65,7 +75,7 @@ def _tree_drift(p1, p0):
 
 
 def _run_resnet(opt_level, keep_bn, loss_scale, opt_name):
-    import main_amp
+    main_amp = _load_imagenet()
 
     opt = (
         FusedAdam(lr=1e-3, impl="jnp")
