@@ -2,9 +2,12 @@
 
 ``tensor_parallel`` — TP/SP mappings, layers, vocab-parallel CE, per-shard RNG,
 activation checkpointing. ``pipeline_parallel`` — schedules and stage
-communication. ``parallel_state`` lives in ``beforeholiday_tpu.parallel``.
+communication. ``context_parallel`` — ring attention over the context axis
+(beyond the reference: long-context is first-class here). ``parallel_state``
+lives in ``beforeholiday_tpu.parallel``.
 """
 
+from beforeholiday_tpu.transformer import context_parallel  # noqa: F401
 from beforeholiday_tpu.transformer import functional  # noqa: F401
 from beforeholiday_tpu.transformer import layers  # noqa: F401
 from beforeholiday_tpu.transformer import pipeline_parallel  # noqa: F401

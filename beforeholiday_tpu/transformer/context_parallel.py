@@ -1,0 +1,104 @@
+"""Context parallelism — ring attention over the ``context`` mesh axis.
+
+The reference has NO context/sequence-dim attention parallelism (SURVEY.md
+§2.6: CP/ring/Ulysses absent; Megatron-SP only shards the residual stream
+between GEMMs). The build contract makes long-context first-class, so this
+module extends the framework the TPU-native way: sequence-sharded attention
+with K/V blocks circulating the ``context`` ring on ICI via ``ppermute``
+(Liu et al.'s ring attention — the blockwise-parallel formulation of flash
+attention across chips).
+
+Per ring step t, rank r holds the K/V chunk that originated on rank
+``(r - t) mod cp`` and folds it into flash-style online-softmax accumulators
+(running max m, running sum l, weighted accumulator acc); ``ppermute``
+shifts K/V one hop per step, so compute on chunk t overlaps the transfer of
+chunk t+1 (XLA's latency-hiding scheduler pipelines the ring the way the
+hand-written double-buffered implementations do). Causality is decided per
+(q, k) GLOBAL position — ranks own contiguous sequence slices in rank
+order. Memory per chip: O(S_local * S_chunk) scores, never the full S^2.
+
+Autodiff provides the backward: the transpose of ``ppermute`` is the
+reverse-direction ``ppermute``, so gradient K/V chunks ride the ring the
+opposite way — exactly the hand-derived ring-attention backward.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.parallel.parallel_state import CONTEXT_AXIS
+
+_NEG = -1e30
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    axis_name: str = CONTEXT_AXIS,
+) -> jax.Array:
+    """Sequence-sharded attention. Runs INSIDE shard_map with ``axis_name``
+    bound; q/k/v: (B, H, S_local, D), the global sequence laid out in rank
+    order along the axis. Returns (B, H, S_local, D) in q's dtype."""
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, H, S_local, D), got {q.shape}")
+    B, H, Sl, D = q.shape
+    scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    qf = q.astype(jnp.float32)
+    q_pos = rank * Sl + jnp.arange(Sl)  # global query positions
+
+    def accum(k_cur, v_cur, src, m, l, acc):
+        """Fold one K/V chunk (originating on rank ``src``) into the
+        online-softmax accumulators."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * Sl + jnp.arange(Sl)
+            masked = k_pos[None, :] > q_pos[:, None]  # global causal
+            s = jnp.where(masked, _NEG, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(masked, 0.0, p)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = alpha * acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        return m_new, l, acc
+
+    # chunk 0 is already local: accumulate before any transfer, then run
+    # cp-1 rotate-then-compute steps — no dead final hop (a collective in the
+    # scan body cannot be DCE'd, so an unconditional trailing rotate would
+    # ship both chunks one wasted hop per call, fwd AND transposed bwd)
+    m0 = jnp.full((B, H, Sl, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, Sl, D), jnp.float32)
+    m, l, acc = accum(k, v, rank, m0, l0, acc0)
+
+    def body(carry, t):
+        k_cur, v_cur, m, l, acc = carry
+        # rotate first: compute on the received chunk overlaps the next
+        # step's transfer under XLA's latency-hiding scheduler
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (rank - t) % cp
+        m, l, acc = accum(k_cur, v_cur, src, m, l, acc)
+        return (k_cur, v_cur, m, l, acc), None
+
+    if cp > 1:
+        (_, _, m, l, acc), _ = jax.lax.scan(
+            body, (k, v, m, l, acc), jnp.arange(1, cp)
+        )
+    nonempty = l > 0.0
+    out = jnp.where(nonempty, acc / jnp.where(nonempty, l, 1.0), 0.0)
+    return out.astype(q.dtype)
