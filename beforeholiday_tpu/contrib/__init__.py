@@ -10,7 +10,14 @@ from beforeholiday_tpu.contrib.bottleneck import (  # noqa: F401
     spatial_bottleneck,
 )
 from beforeholiday_tpu.contrib.clip_grad import clip_grad_norm_  # noqa: F401
+from beforeholiday_tpu.contrib.fmha import fmha  # noqa: F401
 from beforeholiday_tpu.contrib.focal_loss import focal_loss  # noqa: F401
+from beforeholiday_tpu.contrib.multihead_attn import (  # noqa: F401
+    encdec_multihead_attn,
+    init_encdec_multihead_attn,
+    init_self_multihead_attn,
+    self_multihead_attn,
+)
 from beforeholiday_tpu.contrib.groupbn import batch_norm_nhwc  # noqa: F401
 from beforeholiday_tpu.contrib.index_mul_2d import index_mul_2d  # noqa: F401
 from beforeholiday_tpu.contrib.peer_memory import halo_exchange_1d  # noqa: F401

@@ -1,0 +1,77 @@
+"""FMHA — fused MHA over variable-length packed batches
+(ref: apex/contrib/fmha/fmha.py:33-60 ``FMHAFun``/``FMHA``: CUTLASS kernel,
+seq <= 512, packed qkv (total_tokens, 3, H, D) + cu_seqlens).
+
+TPU design: the packed-ragged layout exists because CUDA kernels can chase
+per-sequence pointers; XLA wants static shapes. The wrapper unpacks the
+ragged batch into padded-dense (B, max_s) with a gather, runs the Pallas
+flash attention masked by per-sequence lengths (the same masking the CUTLASS
+kernel derives from cu_seqlens), and gathers valid tokens back — two
+O(total) gathers around one fused kernel, no host-side loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from beforeholiday_tpu.ops import flash_attention
+
+
+def fmha(
+    qkv: jax.Array,
+    cu_seqlens: jax.Array,
+    max_s: int,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """qkv (total, 3, H, D) packed tokens; cu_seqlens (B+1,) int offsets;
+    returns (total, H, D) contexts (ref: FMHAFun.forward).
+
+    ``max_s`` must be static (it sizes the padded batch, like the
+    reference's max_s kernel parameter).
+    """
+    if qkv.ndim != 4 or qkv.shape[1] != 3:
+        raise ValueError(f"expected packed qkv (total, 3, H, D), got {qkv.shape}")
+    total, _, H, D = qkv.shape
+    B = cu_seqlens.shape[0] - 1
+    lens = (cu_seqlens[1:] - cu_seqlens[:-1]).astype(jnp.int32)  # (B,)
+    # PRECONDITION (as the reference kernel enforces): every sequence fits in
+    # max_s. Validated eagerly when cu_seqlens is concrete; under jit the
+    # lengths are traced, so violating tokens are zeroed below instead of
+    # silently receiving a clamped-gather copy of another token's context.
+    try:
+        conc = np.asarray(cu_seqlens)
+        bad = np.diff(conc).max(initial=0)
+        if bad > max_s:
+            raise ValueError(
+                f"sequence length {bad} exceeds max_s={max_s} "
+                "(the reference kernel's hard limit)"
+            )
+    except jax.errors.TracerArrayConversionError:
+        pass
+
+    # padded gather: padded[b, s] = qkv[cu[b] + s], clipped into range (the
+    # clipped duplicates sit beyond each sequence's length and are masked out
+    # by kv_lens inside the kernel / ignored by the final gather)
+    idx = jnp.clip(cu_seqlens[:-1, None] + jnp.arange(max_s)[None, :], 0, total - 1)
+    padded = jnp.take(qkv, idx.reshape(-1), axis=0).reshape(B, max_s, 3, H, D)
+    q, k, v = (padded[:, :, i].transpose(0, 2, 1, 3) for i in range(3))  # (B,H,S,D)
+
+    ctx = flash_attention(
+        q, k, v, causal=causal, scale=scale, kv_lens=lens, impl=impl
+    )  # (B, H, max_s, D)
+    ctx = ctx.transpose(0, 2, 1, 3)  # (B, max_s, H, D)
+
+    # pack back: token t belongs to sequence seg(t) at offset t - cu[seg(t)];
+    # offsets beyond max_s (precondition violations) come back as zeros
+    tok = jnp.arange(total)
+    seg = jnp.searchsorted(cu_seqlens[1:], tok, side="right").astype(jnp.int32)
+    off = tok - jnp.take(cu_seqlens, seg)
+    out = ctx[seg, jnp.clip(off, 0, max_s - 1)]
+    return jnp.where((off < max_s)[:, None, None], out, 0.0).astype(qkv.dtype)

@@ -139,9 +139,10 @@ def _fa_fwd_kernel(causal, scale, nk, bq, bk, lens_ref, q_ref, k_ref, v_ref,
 
 
 def _fa_fwd_pallas(q, k, v, lens, causal, scale, interpret):
-    BH, S, D = q.shape
-    bq = bk = _block_size(S)
-    nq, nk = S // bq, S // bk
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq, bk = _block_size(Sq), _block_size(Sk)
+    nq, nk = Sq // bq, Sk // bk
     # lens rides scalar-prefetch SMEM (a (1,1)-blocked SMEM operand fails
     # Mosaic's tiling check); index maps receive the scalar ref last
     qspec = pl.BlockSpec((1, bq, D), lambda b, i, j, lens_ref: (b, i, 0))
@@ -168,7 +169,7 @@ def _fa_fwd_pallas(q, k, v, lens, causal, scale, interpret):
         ),
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((BH, S, 128), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Sq, 128), jnp.float32),
         ],
         interpret=interpret,
     )(lens.astype(jnp.int32), q, k, v)
@@ -262,9 +263,10 @@ def _fa_dkv_kernel(causal, scale, nq, bq, bk, lens_ref, q_ref, k_ref, v_ref,
 
 
 def _fa_bwd_pallas(q, k, v, do, o, lse, lens, causal, scale, interpret):
-    BH, S, D = q.shape
-    bq = bk = _block_size(S)
-    nq, nk = S // bq, S // bk
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq, bk = _block_size(Sq), _block_size(Sk)
+    nq, nk = Sq // bq, Sk // bk
     lens_i = lens.astype(jnp.int32)
     qspec_i = pl.BlockSpec((1, bq, D), lambda b, i, j, lens_ref: (b, i, 0))
     kspec_j = pl.BlockSpec((1, bk, D), lambda b, i, j, lens_ref: (b, j, 0))
@@ -347,10 +349,11 @@ _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 def _attn_jnp(q, k, v, lens, causal, scale):
     BH, S, D = q.shape
+    Sk = k.shape[1]
     s = jnp.einsum(
         "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
-    kj = jnp.arange(S)
+    kj = jnp.arange(Sk)
     masked = kj[None, None, :].astype(jnp.float32) >= lens[:, None, None]
     if causal:
         masked |= kj[None, :] > jnp.arange(S)[:, None]
@@ -395,35 +398,44 @@ def flash_attention(
     if act is not None:
         q, k, v = q.astype(act), k.astype(act), v.astype(act)
     B, H, S, D = q.shape
-    if k.shape != q.shape or v.shape != q.shape:
-        raise ValueError(f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}")
+    Sk = k.shape[2]
+    if k.shape != v.shape or k.shape[:2] != q.shape[:2] or k.shape[3] != D:
+        raise ValueError(f"q/k/v shapes mismatch, got {q.shape}/{k.shape}/{v.shape}")
+    if causal and Sk != S:
+        raise ValueError(
+            f"causal attention needs matching q/k lengths, got {S} vs {Sk}"
+        )
     scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
     forced = impl is not None
     impl = _resolve_impl(impl)
-    if impl == "pallas" and not is_flash_available(S, D):
+    if impl == "pallas" and not (
+        is_flash_available(S, D) and is_flash_available(Sk, D)
+    ):
         if forced:
             # resolve_impl's contract: an explicit impl= is always honored —
             # so an impossible forced request errors instead of a silent swap
             raise ValueError(
                 f"impl='pallas' forced but shapes don't tile the kernel: "
-                f"S={S} (needs % {_MIN_BLOCK} == 0), head_dim={D} (needs 8..512); "
-                f"pass impl=None for automatic fallback"
+                f"q len {S} / kv len {Sk} (both need % {_MIN_BLOCK} == 0), "
+                f"head_dim={D} (needs 8..512); pass impl=None for automatic "
+                f"fallback"
             )
         impl = "jnp"
 
     if kv_lens is None:
-        lens = jnp.full((B,), float(S), jnp.float32)
+        lens = jnp.full((B,), float(Sk), jnp.float32)
     else:
         lens = kv_lens.astype(jnp.float32)
     lens_bh = jnp.repeat(lens, H)  # (B*H,): per-head copy of each seq length
 
     q3 = q.reshape(B * H, S, D)
-    k3 = k.reshape(B * H, S, D)
-    v3 = v.reshape(B * H, S, D)
-    if impl == "pallas":
-        o = _flash3(q3, k3, v3, lens_bh, causal, scale)
-    else:
-        o = _attn_jnp(q3, k3, v3, lens_bh, causal, scale)
+    k3 = k.reshape(B * H, Sk, D)
+    v3 = v.reshape(B * H, Sk, D)
+    with jax.named_scope("flash_attention"):  # XProf range (NVTX idiom)
+        if impl == "pallas":
+            o = _flash3(q3, k3, v3, lens_bh, causal, scale)
+        else:
+            o = _attn_jnp(q3, k3, v3, lens_bh, causal, scale)
     return o.reshape(B, H, S, D)
 
 

@@ -1,6 +1,7 @@
-"""Shared utilities: logging, pytree helpers, timers."""
+"""Shared utilities: logging, pytree helpers, timers, profiling."""
 
 from beforeholiday_tpu.utils.logging import get_logger
+from beforeholiday_tpu.utils.profiling import annotate, nvtx_range, trace
 from beforeholiday_tpu.utils.timers import Timers
 
-__all__ = ["get_logger", "Timers"]
+__all__ = ["get_logger", "Timers", "annotate", "nvtx_range", "trace"]

@@ -302,6 +302,8 @@ def parse_args(argv=None):
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--print-freq", "-p", type=int, default=10)
     p.add_argument("--deterministic", action="store_true")
+    p.add_argument("--profile-dir", default=None,
+                   help="write an XProf trace of one epoch here")
     return p.parse_args(argv)
 
 
@@ -319,12 +321,17 @@ def main(argv=None):
         seed=0 if args.deterministic else int(time.time()) % (2**31),
     )
     print(f"devices: {jax.device_count()}  distributed: {trainer.distributed}")
+    from beforeholiday_tpu.utils.profiling import trace as profile_trace
+
     best = 0.0
     for epoch in range(args.epochs):
-        best = max(best, train(
-            trainer, iters=args.iters, image_size=args.image_size,
-            base_lr=args.lr, print_freq=args.print_freq, epoch=epoch,
-        ))
+        # trace exactly one epoch (the first), as the flag promises — tracing
+        # a whole multi-epoch run accumulates unloadable multi-GB profiles
+        with profile_trace(args.profile_dir if epoch == 0 else None):
+            best = max(best, train(
+                trainer, iters=args.iters, image_size=args.image_size,
+                base_lr=args.lr, print_freq=args.print_freq, epoch=epoch,
+            ))
     print(f"peak speed: {best:.1f} img/s")
     return best
 
