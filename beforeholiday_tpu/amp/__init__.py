@@ -15,6 +15,7 @@ from beforeholiday_tpu.amp.frontend import (  # noqa: F401
     scaled_value_and_grad,
 )
 from beforeholiday_tpu.amp.scaler import LossScaler  # noqa: F401
+from beforeholiday_tpu.amp import functional  # noqa: F401
 
 # per-op cast policy (the O1/O4 "patch engine"; ref: apex/amp/amp.py:29-71
 # decorators + lists/functional_overrides.py) — lives in ops to stay below

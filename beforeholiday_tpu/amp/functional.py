@@ -1,0 +1,114 @@
+"""amp.functional — the wrapped function namespace O1 users call
+(ref: apex/amp/lists/functional_overrides.py:17-91 +
+torch_overrides.py:7-139 — the FP16_FUNCS / FP32_FUNCS / CASTS / BANNED
+lists the patch engine applies to ``torch.*`` and ``torch.nn.functional.*``).
+
+JAX functions cannot be monkey-patched under trace; instead this module
+exposes pre-wrapped equivalents of the listed functions. The repo's own
+fused ops (dense/MLP/attention: low precision; norms/losses: fp32) are
+tagged at their definitions — this namespace covers the plain jnp/jax.nn
+functions a model might call directly:
+
+* FP32_FUNCS — transcendentals & probability ops promoted to fp32 under an
+  active autocast scope: softmax, log_softmax, exp, log, log1p, pow,
+  logsumexp, cross_entropy, mse_loss, l1_loss, nll_loss, softplus, erf;
+* CASTS (promote) — multi-dtype binary ops promoted to the widest floating
+  input: add, sub, mul, div, matmul (addcdiv/addcmul have no jnp
+  counterpart; compose from these);
+* BANNED — ``binary_cross_entropy`` raises under fp16 autocast exactly like
+  the reference (:80-91); use ``binary_cross_entropy_with_logits``.
+
+Outside an autocast scope every wrapper is the identity around its jnp
+implementation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.ops._autocast import (
+    banned_function,
+    float_function,
+    promote_function,
+)
+
+__all__ = [
+    "softmax", "log_softmax", "exp", "log", "log1p", "pow", "logsumexp",
+    "softplus", "erf", "cross_entropy", "nll_loss", "mse_loss", "l1_loss",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "add", "sub", "mul", "div", "matmul",
+]
+
+# -- FP32_FUNCS -------------------------------------------------------------------
+
+softmax = float_function(jax.nn.softmax)
+log_softmax = float_function(jax.nn.log_softmax)
+exp = float_function(jnp.exp)
+log = float_function(jnp.log)
+log1p = float_function(jnp.log1p)
+pow = float_function(jnp.power)  # noqa: A001 - mirrors the reference list name
+logsumexp = float_function(jax.nn.logsumexp)
+softplus = float_function(jax.nn.softplus)
+erf = float_function(jax.scipy.special.erf)
+
+
+@float_function
+def cross_entropy(logits, labels, *, smoothing: float = 0.0):
+    """Mean label-smoothing CE over (N, C) logits (F.cross_entropy)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    if smoothing:
+        nll = (1.0 - smoothing) * nll - smoothing * jnp.mean(logp, axis=-1)
+    return jnp.mean(nll)
+
+
+@float_function
+def nll_loss(logp, labels):
+    """Mean NLL over (N, C) log-probabilities (F.nll_loss)."""
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+@float_function
+def mse_loss(pred, target):
+    return jnp.mean((pred - target) ** 2)
+
+
+@float_function
+def l1_loss(pred, target):
+    return jnp.mean(jnp.abs(pred - target))
+
+
+# -- BANNED (ref: functional_overrides.py:80-91) ----------------------------------
+
+
+def _bce(probs, targets):
+    eps = 1e-12
+    p = jnp.clip(probs, eps, 1.0 - eps)
+    return -jnp.mean(targets * jnp.log(p) + (1.0 - targets) * jnp.log1p(-p))
+
+
+binary_cross_entropy = banned_function(
+    _bce,
+    "binary_cross_entropy",
+    "fp16 probabilities saturate; use binary_cross_entropy_with_logits "
+    "(the reference raises the same way)",
+)
+
+
+@float_function
+def binary_cross_entropy_with_logits(logits, targets):
+    """The amp-safe replacement the reference error message points to."""
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * targets
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+# -- CASTS: promote-to-widest binary ops ------------------------------------------
+
+add = promote_function(jnp.add)
+sub = promote_function(jnp.subtract)
+mul = promote_function(jnp.multiply)
+div = promote_function(jnp.divide)
+matmul = promote_function(jnp.matmul)

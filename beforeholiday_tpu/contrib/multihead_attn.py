@@ -45,11 +45,16 @@ def init_self_multihead_attn(
         p["q_weight"] = u(ks[0], (embed_dim, embed_dim))
         p["k_weight"] = u(ks[1], (embed_dim, embed_dim))
         p["v_weight"] = u(ks[2], (embed_dim, embed_dim))
+        if bias:
+            p["q_bias"] = jnp.zeros((embed_dim,))
+            p["k_bias"] = jnp.zeros((embed_dim,))
+            p["v_bias"] = jnp.zeros((embed_dim,))
     else:
         p["qkv_weight"] = u(ks[0], (3 * embed_dim, embed_dim))
+        if bias:
+            p["qkv_bias"] = jnp.zeros((3 * embed_dim,))
     p["out_weight"] = u(ks[3], (embed_dim, embed_dim))
     if bias:
-        p["qkv_bias"] = jnp.zeros((3 * embed_dim,))
         p["out_bias"] = jnp.zeros((embed_dim,))
     if include_norm_add:
         p["ln_scale"] = jnp.ones((embed_dim,))
@@ -100,6 +105,10 @@ def self_multihead_attn(
     q = h @ params["q_weight"].T.astype(h.dtype)
     k = h @ params["k_weight"].T.astype(h.dtype)
     v = h @ params["v_weight"].T.astype(h.dtype)
+    if "q_bias" in params:
+        q = q + params["q_bias"].astype(h.dtype)
+        k = k + params["k_bias"].astype(h.dtype)
+        v = v + params["v_bias"].astype(h.dtype)
     ctx = flash_attention(
         _split_heads(q, B, S, num_heads),
         _split_heads(k, B, S, num_heads),
@@ -153,6 +162,10 @@ def encdec_multihead_attn(
         h = fused_layer_norm(query, params["ln_scale"], params["ln_bias"]).astype(
             query.dtype
         )
+    act = autocast_dtype()
+    if act is not None:  # keep the sibling modules' amp behavior consistent
+        h = h.astype(act)
+        memory = memory.astype(act)
     q = h @ params["q_weight"].T.astype(h.dtype)
     if "q_bias" in params:
         q = q + params["q_bias"].astype(h.dtype)
@@ -167,10 +180,10 @@ def encdec_multihead_attn(
         causal=False, kv_lens=key_padding_lens, impl=impl,
     )
     out = ctx.transpose(0, 2, 1, 3).reshape(B, Sq, E) @ params["out_weight"].T.astype(
-        query.dtype
+        ctx.dtype
     )
     if "out_bias" in params:
-        out = out + params["out_bias"].astype(query.dtype)
+        out = out + params["out_bias"].astype(out.dtype)
     if include_norm_add:
         out = out + query
     return out

@@ -232,6 +232,13 @@ def main():
     if o0_s:
         detail["o0_fp32_step_ms"] = round(o0_s * 1e3, 2)
         detail["o0_img_per_s"] = round(batch / o0_s, 1)
+    if o5_s:
+        # effective model FLOP rate (ResNet-50 fwd+bwd ~ 3x 4.1 GFLOP/img):
+        # at 56 ms/step this is ~28 TFLOP/s — i.e. real v5e-class throughput,
+        # while the single-matmul calibration above reads ~1 TFLOP/s; the
+        # tunnel distorts small/isolated dispatches far more than big fused
+        # programs, so model-level numbers are the trustworthy ones here
+        detail["resnet_o5_model_tflops"] = round(3 * 4.1e9 * batch / o5_s / 1e12, 2)
 
     adam = _stage(detail, bench_fused_adam)
     if adam:

@@ -163,3 +163,32 @@ class TestProfiling:
         assert produced, "no profile artifacts written"
         with trace(None):  # disabled path is a no-op
             pass
+
+
+class TestMhaAmpConsistency:
+    def test_separate_qkv_bias_is_live(self):
+        """bias=True with separate_qkv_params must produce per-projection
+        biases that actually affect the output (not dead params)."""
+        B, S, E, H = 1, 32, 16, 2
+        params = init_self_multihead_attn(
+            jax.random.PRNGKey(0), E, bias=True, separate_qkv_params=True
+        )
+        assert {"q_bias", "k_bias", "v_bias"} <= set(params)
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, S, E))
+        out0 = self_multihead_attn(params, x, H)
+        bumped = dict(params, q_bias=params["q_bias"] + 1.0)
+        out1 = self_multihead_attn(bumped, x, H)
+        assert not np.allclose(np.asarray(out0), np.asarray(out1))
+
+    def test_both_modules_cast_under_autocast(self):
+        from beforeholiday_tpu import amp
+
+        E, H = 16, 2
+        sp = init_self_multihead_attn(jax.random.PRNGKey(0), E)
+        ep = init_encdec_multihead_attn(jax.random.PRNGKey(1), E)
+        x = jnp.ones((1, 32, E))
+        mem = jnp.ones((1, 64, E))
+        with amp.autocast(jnp.bfloat16):
+            assert self_multihead_attn(sp, x, H).dtype == jnp.bfloat16
+            assert encdec_multihead_attn(ep, x, mem, H).dtype == jnp.bfloat16
+        assert self_multihead_attn(sp, x, H).dtype == jnp.float32
