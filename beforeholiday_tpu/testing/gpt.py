@@ -177,9 +177,15 @@ def forward(params: dict, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
     return _constrain(logits, P(DATA_AXIS, None, TENSOR_AXIS))
 
 
-def loss_fn(params: dict, tokens: jax.Array, targets: jax.Array, cfg: GPTConfig):
-    """Mean next-token cross entropy."""
-    logits = forward(params, tokens, cfg)
+def loss_fn(params: dict, tokens: jax.Array, targets: jax.Array, cfg: GPTConfig,
+            forward_fn=None):
+    """Mean next-token cross entropy. ``forward_fn(params, tokens)`` overrides
+    the plain forward (e.g. an amp-wrapped apply) while keeping ONE loss
+    definition for trainers/benches."""
+    if forward_fn is None:
+        logits = forward(params, tokens, cfg)
+    else:
+        logits = forward_fn(params, tokens)
     logz = jax.nn.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(logz - tgt)

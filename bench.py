@@ -159,6 +159,56 @@ def bench_bert_lamb(iters: int = 3):
     return None, "all_failed"
 
 
+def bench_gpt_train(iters: int = 5):
+    """Flagship GPT training step (BASELINE config 5 shape): amp O5 + flash
+    attention + FusedAdam, single chip. Geometries tried largest-first under
+    the tunnel's compile-payload limit. Returns (step_s, tokens, tag)."""
+    from beforeholiday_tpu import amp
+    from beforeholiday_tpu.optimizers import FusedAdam
+    from beforeholiday_tpu.testing import gpt
+
+    candidates = [
+        ("gpt_512x8_6layer_s1024", gpt.GPTConfig(
+            vocab_size=32000, seq_len=1024, d_model=512, n_heads=8, n_layers=6,
+            dtype=jnp.bfloat16)),
+        ("gpt_256x4_4layer_s512", gpt.GPTConfig(
+            vocab_size=8192, seq_len=512, d_model=256, n_heads=4, n_layers=4,
+            dtype=jnp.bfloat16)),
+    ]
+    batch = 8
+    for tag, cfg in candidates:
+        try:
+            params = gpt.init(jax.random.PRNGKey(0), cfg)
+            tokens, targets = gpt.synthetic_batch(jax.random.PRNGKey(1), cfg, batch)
+            m = amp.initialize(
+                lambda p, t: gpt.forward(p, t, cfg), params,
+                FusedAdam(lr=1e-4), "O5",
+            )
+
+            def loss_fn(p, tok, tgt):
+                return gpt.loss_fn(p, tok, tgt, cfg, forward_fn=m.apply)
+
+            svag = amp.scaled_value_and_grad(loss_fn, m.scaler)
+            opt_state = m.optimizer.init(m.params)
+            sstate = m.scaler.init()
+
+            @jax.jit
+            def step(p, o, s):
+                loss, g, fi, s = svag(p, s, tokens, targets)
+                p, o = m.optimizer.step(p, g, o, found_inf=fi)
+                return p, o, s, loss
+
+            t = _time_it(lambda p, o, s: step(p, o, s),
+                         (m.params, opt_state, sstate), iters=iters)
+            return t, batch * cfg.seq_len, tag
+        except Exception as e:
+            import sys
+
+            print(f"# gpt bench {tag} failed: {type(e).__name__}",
+                  file=sys.stderr, flush=True)
+    return None, 0, "all_failed"
+
+
 def bench_fused_adam():
     from beforeholiday_tpu.ops import multi_tensor_adam
     import optax
@@ -257,6 +307,12 @@ def main():
     if bert_res and bert_res[0]:
         detail["bert_lamb_step_ms"] = round(bert_res[0] * 1e3, 2)
         detail["bert_lamb_config"] = bert_res[1]
+
+    gpt_res = _stage(detail, bench_gpt_train)
+    if gpt_res and gpt_res[0]:
+        detail["gpt_o5_step_ms"] = round(gpt_res[0] * 1e3, 2)
+        detail["gpt_o5_tokens_per_s"] = round(gpt_res[1] / gpt_res[0], 1)
+        detail["gpt_config"] = gpt_res[2]
 
     print(json.dumps({
         "metric": "resnet50_amp_O5_train",
