@@ -172,6 +172,7 @@ def initialize(
     keep_fp32_mask: Optional[Callable] = None,
     has_state: bool = False,
     num_losses: int = 1,
+    arena_masters: bool = False,
 ) -> AmpModel:
     """Apply an opt-level policy to (apply_fn, params, optimizer).
 
@@ -220,7 +221,10 @@ def initialize(
 
     opt = optimizer
     if opt is not None and policy.master_weights:
-        opt = MasterWeights(opt)
+        # arena_masters keeps fp32 masters + optimizer state packed flat and
+        # fuses the master->model cast into the optimizer kernel (single-device
+        # / manual-shard_map fast path; see MasterWeights docstring)
+        opt = MasterWeights(opt, arena=arena_masters)
 
     if num_losses < 1:
         raise ValueError(f"num_losses must be >= 1, got {num_losses}")

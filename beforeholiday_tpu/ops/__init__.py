@@ -14,6 +14,9 @@
 
 from .arena import ArenaSpec, flatten, make_spec, unflatten  # noqa: F401
 from .multi_tensor import (  # noqa: F401
+    adam_flat,
+    lamb_flat,
+    sgd_flat,
     multi_tensor_adagrad,
     multi_tensor_adam,
     multi_tensor_axpby,

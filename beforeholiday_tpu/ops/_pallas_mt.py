@@ -53,6 +53,16 @@ def _choose_rows(rows: int) -> int:
     return BLOCK_ROWS
 
 
+def _compiler_params(interpret: bool):
+    """Explicitly declare the grid dimension ``arbitrary`` (sequential): the
+    overflow/l2norm kernels ACCUMULATE across grid steps, so the grid must not
+    be parallelized across cores. This is the TPU default today; declaring it
+    pins the correctness requirement. Interpret mode takes no TPU params."""
+    if interpret:
+        return {}
+    return {"compiler_params": pltpu.CompilerParams(dimension_semantics=("arbitrary",))}
+
+
 def ew_call(
     kernel,
     arrays: Sequence[jax.Array],
@@ -103,6 +113,7 @@ def ew_call(
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
+        **_compiler_params(interpret),
     )(scal, fi, *[a.reshape(rows, LANES) for a in arrays])
 
     if overflow:
@@ -216,6 +227,7 @@ def l2norm_sq(x_flat, *, interpret=None):
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ],
         interpret=interpret,
+        **_compiler_params(interpret),
     )(jnp.zeros((1, 1), jnp.float32), jnp.zeros((1, 1), jnp.float32),
       x_flat.reshape(rows, LANES))
     return acc[0, 0], flag[0, 0] != 0
@@ -226,7 +238,8 @@ def l2norm_sq(x_flat, *, interpret=None):
 # --------------------------------------------------------------------------------
 
 
-def _adam_kernel(mode, scal_ref, fi_ref, g_ref, p_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref):
+def _adam_kernel(mode, scal_ref, fi_ref, g_ref, p_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref,
+                 co_ref=None):
     beta1, beta2 = scal_ref[0, 0], scal_ref[0, 1]
     bc1, bc2 = scal_ref[0, 2], scal_ref[0, 3]
     eps, lr, decay = scal_ref[0, 4], scal_ref[0, 5], scal_ref[0, 6]
@@ -243,9 +256,17 @@ def _adam_kernel(mode, scal_ref, fi_ref, g_ref, p_ref, m_ref, v_ref, po_ref, mo_
         update = update + decay * p
     p_new = p - lr * update
 
-    po_ref[...] = jnp.where(skip, p, p_new).astype(po_ref.dtype)
+    held = jnp.where(skip, p, p_new)
+    po_ref[...] = held.astype(po_ref.dtype)
     mo_ref[...] = jnp.where(skip, m, m_new).astype(mo_ref.dtype)
     vo_ref[...] = jnp.where(skip, v, v_new).astype(vo_ref.dtype)
+    if co_ref is not None:
+        # low-precision model copy emitted in the same pass — the amp O2/O5
+        # master->model cast with zero extra HBM reads (the reference pays a
+        # separate _master_params_to_model_params copy,
+        # apex/amp/_process_optimizer.py:14-25; its 4-list sgd kernel has the
+        # same in-kernel copy idea, multi_tensor_sgd_kernel.cu:61-130)
+        co_ref[...] = held.astype(co_ref.dtype)
 
 
 def adam(
@@ -264,13 +285,17 @@ def adam(
     adam_w_mode=True,
     grad_scale=1.0,
     found_inf=None,
+    model_copy_dtype=None,
     interpret=None,
 ):
+    out_dtypes = [p_flat.dtype, m_flat.dtype, v_flat.dtype]
+    if model_copy_dtype is not None:
+        out_dtypes.append(model_copy_dtype)
     outs, _ = ew_call(
         functools.partial(_adam_kernel, 1 if adam_w_mode else 0),
         [g_flat, p_flat, m_flat, v_flat],
         [beta1, beta2, bias_correction1, bias_correction2, eps, lr, weight_decay, grad_scale],
-        [p_flat.dtype, m_flat.dtype, v_flat.dtype],
+        out_dtypes,
         found_inf=found_inf,
         interpret=interpret,
     )
@@ -493,20 +518,26 @@ def novograd_ew(
 # --------------------------------------------------------------------------------
 
 
-def _scaled_update_kernel(scal_ref, fi_ref, p_ref, u_ref, c_ref, po_ref):
+def _scaled_update_kernel(scal_ref, fi_ref, p_ref, u_ref, c_ref, po_ref, co_ref=None):
     skip = fi_ref[0, 0] != 0.0
     p, u, c = _f32(p_ref), _f32(u_ref), _f32(c_ref)
-    p_new = p - c * u
-    po_ref[...] = jnp.where(skip, p, p_new).astype(po_ref.dtype)
+    p_new = jnp.where(skip, p, p - c * u)
+    po_ref[...] = p_new.astype(po_ref.dtype)
+    if co_ref is not None:  # in-pass low-precision model copy (see _adam_kernel)
+        co_ref[...] = p_new.astype(co_ref.dtype)
 
 
-def apply_scaled_update(p_flat, u_flat, coef_flat, *, found_inf=None, interpret=None):
+def apply_scaled_update(p_flat, u_flat, coef_flat, *, found_inf=None,
+                        model_copy_dtype=None, interpret=None):
+    out_dtypes = [p_flat.dtype]
+    if model_copy_dtype is not None:
+        out_dtypes.append(model_copy_dtype)
     outs, _ = ew_call(
         _scaled_update_kernel,
         [p_flat, u_flat, coef_flat],
         [],
-        [p_flat.dtype],
+        out_dtypes,
         found_inf=found_inf,
         interpret=interpret,
     )
-    return outs[0]
+    return outs[0] if model_copy_dtype is None else (outs[0], outs[1])

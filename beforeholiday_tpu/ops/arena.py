@@ -55,6 +55,12 @@ class ArenaSpec:
         l2norm — ref: csrc/multi_tensor_l2norm_kernel.cu per-tensor outputs) are
         one ``segment_sum`` over the arena. Cached per spec — LAMB queries it
         three times per eager step and the table is O(arena).
+
+        Under jit prefer static slicing (multi_tensor.per_tensor_sumsq) or
+        :func:`segment_ids_of` (ZeRO shards): this host table becomes an
+        O(arena)-byte CONSTANT baked into the compiled program (a 46M-param
+        LAMB step ships ~186 MB of table per use — the cause of the r03
+        compile-payload blowup on mid-size BERT).
         """
         return _segment_ids_cached(self)
 
@@ -67,6 +73,23 @@ def _segment_ids_cached(spec: "ArenaSpec") -> np.ndarray:
         ids[off : off + n] = i
     ids.setflags(write=False)  # shared across callers
     return ids
+
+
+def segment_ids_of(spec: ArenaSpec, idx: jax.Array) -> jax.Array:
+    """Owning-tensor index for each (possibly dynamic) arena position in
+    ``idx``; positions >= spec.total map to ``num_tensors`` (padding segment).
+
+    Implemented as a broadcast compare-and-sum against the static boundary
+    list — ``seg[i] = #{j : boundary_j <= idx[i]}`` — which XLA fuses into one
+    pass. NOT ``jnp.searchsorted``: its scan carry is an (N, 2) array whose
+    size-2 trailing dim TPU tiling pads to 128 lanes (64x memory, 21 GB on a
+    42M arena — the compile-time OOM this replaced).
+    """
+    sizes = [int(np.prod(s)) if s else 1 for s in spec.shapes]
+    boundaries = jnp.asarray(np.cumsum(sizes, dtype=np.int64), dtype=jnp.int32)
+    return jnp.sum(
+        idx[:, None] >= boundaries[None, :], axis=1, dtype=jnp.int32
+    )
 
 
 def make_spec(tensors: Sequence[jax.Array]) -> ArenaSpec:
@@ -108,11 +131,26 @@ def unflatten(flat: jax.Array, spec: ArenaSpec, dtype=None) -> List[jax.Array]:
 
     TPU analogue of ``apex_C.unflatten`` (ref: csrc/flatten_unflatten.cpp:11-14).
     Slices are static, so XLA fuses them into consumers — no materialized copy.
+
+    Slicing happens through a (rows, 128) 2D view, NOT directly on the 1D
+    array: the TPU compiler rewrites large-1D-array slicing into an
+    (N/2, 2)-shaped intermediate whose size-2 trailing dim tiling pads 64x —
+    a silent 11.7 GB hidden buffer at 46M params and a compile-time HBM OOM
+    at 84M (BERT-large). Row-sliced 2D views lower cleanly; only the final
+    tensor-sized trim is a 1D op.
     """
     out = []
+    use_2d = flat.shape[0] % LANES == 0
+    rows2d = flat.reshape(-1, LANES) if use_2d else None
     for off, shape in zip(spec.offsets, spec.shapes):
         n = int(np.prod(shape)) if shape else 1
-        piece = jax.lax.dynamic_slice_in_dim(flat, off, n).reshape(shape)
+        if use_2d:
+            r0, r1 = off // LANES, (off + n + LANES - 1) // LANES
+            piece = jax.lax.dynamic_slice_in_dim(rows2d, r0, r1 - r0).reshape(-1)
+            piece = jax.lax.dynamic_slice_in_dim(piece, off - r0 * LANES, n)
+            piece = piece.reshape(shape)
+        else:
+            piece = jax.lax.dynamic_slice_in_dim(flat, off, n).reshape(shape)
         if dtype is not None:
             piece = piece.astype(dtype)
         out.append(piece)

@@ -44,13 +44,28 @@ def _nonfinite_any(x) -> jax.Array:
 def _segment_coef(
     values_per_tensor: jax.Array, spec: ArenaSpec, segment_ids=None
 ) -> jax.Array:
-    """Gather a per-tensor value to a per-element arena vector.
+    """Expand a per-tensor value to a per-element arena vector.
 
-    ``segment_ids`` overrides the spec's static table (ZeRO mode: ids for one
-    arena shard)."""
-    seg = jnp.asarray(spec.segment_ids()) if segment_ids is None else segment_ids
+    Offsets are STATIC, so the default path is a concatenation of per-tensor
+    broadcasts — one HBM write pass, no segment table. (A materialized id
+    table costs an extra arena-sized read; generating ids on device via
+    ``searchsorted`` is worse still: its scan carry is an (N, 2) array that
+    TPU tiling pads 64x, 21 GB at 42M params — the r04 BERT-large OOM.)
+
+    ``segment_ids`` overrides the static layout (ZeRO mode: this device holds
+    one dynamically-positioned arena shard, so ids arrive precomputed)."""
+    if segment_ids is None:
+        parts = [
+            jnp.full((int(np.prod(s)) if s else 1,), values_per_tensor[i],
+                     values_per_tensor.dtype)
+            for i, s in enumerate(spec.shapes)
+        ]
+        pad = spec.padded_total - spec.total
+        if pad:
+            parts.append(jnp.zeros((pad,), values_per_tensor.dtype))
+        return jnp.concatenate(parts)
     padded = jnp.concatenate([values_per_tensor, jnp.zeros((1,), values_per_tensor.dtype)])
-    return padded[seg]
+    return padded[segment_ids]
 
 
 def per_tensor_sumsq(
@@ -59,14 +74,28 @@ def per_tensor_sumsq(
 ) -> jax.Array:
     """Per-tensor sum of squares over the arena (ref: per-tensor l2norm outputs).
 
+    Default path: one static slice+reduce per tensor — offsets are static
+    under jit, XLA fuses the reductions into a single pass over the arena
+    (see _segment_coef for why no id table is involved). Unlike unflatten's
+    materialized output slices (arena.py — the (N/2, 2) tiling pathology),
+    slices feeding reductions fuse and do NOT hit that rewrite: verified by
+    compiling the 84M-param BERT-large FusedLAMB step on a v5e, which calls
+    this twice per step over fp32 arenas.
+
     With ``segment_ids``/``axis_name`` set, ``flat`` is one shard of the arena
     and the partial sums are psum'd across the axis (ZeRO mode) —
     ``num_tensors`` must then be the ORIGINAL tensor count (the shard's own
     spec sees one flat tensor)."""
-    seg = jnp.asarray(spec.segment_ids()) if segment_ids is None else segment_ids
-    n = spec.num_tensors if num_tensors is None else num_tensors
     x = flat.astype(jnp.float32)
-    sums = jax.ops.segment_sum(x * x, seg, num_segments=n + 1)[:-1]
+    if segment_ids is None:
+        sums = jnp.stack([
+            jnp.sum(jax.lax.dynamic_slice_in_dim(
+                x, off, int(np.prod(s)) if s else 1) ** 2)
+            for off, s in zip(spec.offsets, spec.shapes)
+        ])
+    else:
+        n = spec.num_tensors if num_tensors is None else num_tensors
+        sums = jax.ops.segment_sum(x * x, segment_ids, num_segments=n + 1)[:-1]
     if axis_name is not None:
         sums = jax.lax.psum(sums, axis_name)
     return sums
@@ -172,6 +201,68 @@ def _bias_corrections(bias_correction: bool, step, beta1: float, beta2: float):
     return jnp.float32(1.0), jnp.float32(1.0)
 
 
+def adam_flat(
+    gf: jax.Array,
+    pf: jax.Array,
+    mf: jax.Array,
+    vf: jax.Array,
+    *,
+    lr,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    eps: float = 1e-8,
+    step=1,
+    adam_w_mode: bool = True,
+    bias_correction: bool = True,
+    weight_decay: float = 0.0,
+    grad_scale=1.0,
+    found_inf=None,
+    model_copy_dtype=None,
+    impl: Optional[str] = None,
+):
+    """Fused Adam/AdamW over pre-flattened arenas — the arena-resident fast
+    path. The list API (:func:`multi_tensor_adam`) flattens per call, which
+    costs one extra HBM round trip per tree per step; optimizers that keep
+    their state (and fp32 masters) packed call this directly and skip it.
+
+    ``model_copy_dtype`` additionally returns a low-precision copy of the new
+    params emitted in the same kernel pass — the amp O2/O5 master->model cast
+    with zero extra reads (ref: apex/amp/_process_optimizer.py:14-25
+    ``_master_params_to_model_params``; csrc/multi_tensor_sgd_kernel.cu:61-130
+    4-list variant). Returns (p, m, v) or (p, m, v, model_copy).
+    """
+    impl = _resolve(impl)
+    bc1, bc2 = _bias_corrections(bias_correction, step, beta1, beta2)
+    if impl == "pallas":
+        return k.adam(
+            gf, pf, mf, vf,
+            lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+            bias_correction1=bc1, bias_correction2=bc2,
+            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+            grad_scale=grad_scale, found_inf=found_inf,
+            model_copy_dtype=model_copy_dtype,
+        )
+    g = gf.astype(jnp.float32) * grad_scale
+    p, m, v = pf.astype(jnp.float32), mf.astype(jnp.float32), vf.astype(jnp.float32)
+    if not adam_w_mode:
+        g = g + weight_decay * p
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if adam_w_mode:
+        update = update + weight_decay * p
+    p_new = p - lr * update
+    if found_inf is not None:
+        skip = jnp.asarray(found_inf) != 0
+        p_new = jnp.where(skip, p, p_new)
+        m_new = jnp.where(skip, m, m_new)
+        v_new = jnp.where(skip, v, v_new)
+    outs = (p_new.astype(pf.dtype), m_new.astype(mf.dtype), v_new.astype(vf.dtype))
+    if model_copy_dtype is not None:
+        outs = outs + (p_new.astype(model_copy_dtype),)
+    return outs
+
+
 def multi_tensor_adam(
     grads: Sequence[jax.Array],
     params: Sequence[jax.Array],
@@ -196,39 +287,17 @@ def multi_tensor_adam(
     the reference's device-side noop/skip-step (csrc/multi_tensor_apply.cuh noop_gmem,
     apex/amp/handle.py:127-154).
     """
-    impl = _resolve(impl)
-    bc1, bc2 = _bias_corrections(bias_correction, step, beta1, beta2)
     gf, spec = flatten(grads)
     pf, _ = flatten(params)
     mf, _ = flatten(exp_avgs)
     vf, _ = flatten(exp_avg_sqs)
-    if impl == "pallas":
-        p_new, m_new, v_new = k.adam(
-            gf, pf, mf, vf,
-            lr=lr, beta1=beta1, beta2=beta2, eps=eps,
-            bias_correction1=bc1, bias_correction2=bc2,
-            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
-            grad_scale=grad_scale, found_inf=found_inf,
-        )
-    else:
-        g = gf.astype(jnp.float32) * grad_scale
-        p, m, v = pf.astype(jnp.float32), mf.astype(jnp.float32), vf.astype(jnp.float32)
-        if not adam_w_mode:
-            g = g + weight_decay * p
-        m_new = beta1 * m + (1.0 - beta1) * g
-        v_new = beta2 * v + (1.0 - beta2) * g * g
-        update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-        if adam_w_mode:
-            update = update + weight_decay * p
-        p_new = p - lr * update
-        if found_inf is not None:
-            skip = jnp.asarray(found_inf) != 0
-            p_new = jnp.where(skip, p, p_new)
-            m_new = jnp.where(skip, m, m_new)
-            v_new = jnp.where(skip, v, v_new)
-        p_new = p_new.astype(pf.dtype)
-        m_new = m_new.astype(mf.dtype)
-        v_new = v_new.astype(vf.dtype)
+    p_new, m_new, v_new = adam_flat(
+        gf, pf, mf, vf,
+        lr=lr, beta1=beta1, beta2=beta2, eps=eps, step=step,
+        adam_w_mode=adam_w_mode, bias_correction=bias_correction,
+        weight_decay=weight_decay, grad_scale=grad_scale,
+        found_inf=found_inf, impl=impl,
+    )
     return unflatten(p_new, spec), unflatten(m_new, spec), unflatten(v_new, spec)
 
 
@@ -272,6 +341,45 @@ def multi_tensor_adagrad(
 # ---------------------------------------------------------------------------------
 
 
+def sgd_flat(
+    gf, pf, mf, *, lr, weight_decay: float = 0.0, momentum: float = 0.0,
+    dampening: float = 0.0, nesterov: bool = False, first_run: bool = False,
+    wd_after_momentum: bool = False, scale: float = 1.0,
+    model_copy_dtype=None, found_inf=None, impl: Optional[str] = None,
+):
+    """Fused SGD over pre-flattened arenas (see :func:`adam_flat` for why).
+    Returns (params, momentums[, model_copy])."""
+    impl = _resolve(impl)
+    if impl == "pallas":
+        return k.sgd(
+            gf, pf, mf, lr=lr, weight_decay=weight_decay, momentum=momentum,
+            dampening=dampening, nesterov=nesterov, first_run=first_run,
+            wd_after_momentum=wd_after_momentum, scale=scale,
+            model_copy_dtype=model_copy_dtype, found_inf=found_inf,
+        )
+    g = gf.astype(jnp.float32) * scale
+    p, mom = pf.astype(jnp.float32), mf.astype(jnp.float32)
+    if not wd_after_momentum:
+        g = g + weight_decay * p
+    if momentum != 0.0:
+        first = jnp.asarray(first_run, jnp.bool_)
+        mom_new = jnp.where(first, g, mom * momentum + (1.0 - dampening) * g)
+        step = g + momentum * mom_new if nesterov else mom_new
+    else:
+        mom_new, step = mom, g
+    if wd_after_momentum:
+        step = step + weight_decay * p
+    p_new = p - lr * step
+    if found_inf is not None:
+        skip = jnp.asarray(found_inf) != 0
+        p_new = jnp.where(skip, p, p_new)
+        mom_new = jnp.where(skip, mom, mom_new)
+    outs = (p_new.astype(pf.dtype), mom_new.astype(mf.dtype))
+    if model_copy_dtype is not None:
+        outs = outs + (p_new.astype(model_copy_dtype),)
+    return outs
+
+
 def multi_tensor_sgd(
     grads, params, momentums, *, lr, weight_decay: float = 0.0, momentum: float = 0.0,
     dampening: float = 0.0, nesterov: bool = False, first_run: bool = False,
@@ -283,40 +391,16 @@ def multi_tensor_sgd(
     ``model_copy_dtype`` reproduces the reference's 4-list variant that also
     writes a half-precision model-weight copy for amp O2 master weights
     (ref: multi_tensor_sgd_kernel.cu:61-130)."""
-    impl = _resolve(impl)
     gf, spec = flatten(grads)
     pf, _ = flatten(params)
     mf, _ = flatten(momentums)
-    if impl == "pallas":
-        outs = k.sgd(
-            gf, pf, mf, lr=lr, weight_decay=weight_decay, momentum=momentum,
-            dampening=dampening, nesterov=nesterov, first_run=first_run,
-            wd_after_momentum=wd_after_momentum, scale=scale,
-            model_copy_dtype=model_copy_dtype, found_inf=found_inf,
-        )
-    else:
-        g = gf.astype(jnp.float32) * scale
-        p, mom = pf.astype(jnp.float32), mf.astype(jnp.float32)
-        if not wd_after_momentum:
-            g = g + weight_decay * p
-        if momentum != 0.0:
-            first = jnp.asarray(first_run, jnp.bool_)
-            mom_new = jnp.where(first, g, mom * momentum + (1.0 - dampening) * g)
-            step = g + momentum * mom_new if nesterov else mom_new
-        else:
-            mom_new, step = mom, g
-        if wd_after_momentum:
-            step = step + weight_decay * p
-        p_new = p - lr * step
-        if found_inf is not None:
-            skip = jnp.asarray(found_inf) != 0
-            p_new = jnp.where(skip, p, p_new)
-            mom_new = jnp.where(skip, mom, mom_new)
-        outs = [p_new.astype(pf.dtype), mom_new.astype(mf.dtype)]
-        if model_copy_dtype is not None:
-            outs.append(p_new.astype(model_copy_dtype))
-    result = [unflatten(o, spec) for o in outs]
-    return tuple(result)
+    outs = sgd_flat(
+        gf, pf, mf, lr=lr, weight_decay=weight_decay, momentum=momentum,
+        dampening=dampening, nesterov=nesterov, first_run=first_run,
+        wd_after_momentum=wd_after_momentum, scale=scale,
+        model_copy_dtype=model_copy_dtype, found_inf=found_inf, impl=impl,
+    )
+    return tuple(unflatten(o, spec) for o in outs)
 
 
 # ---------------------------------------------------------------------------------
@@ -388,29 +472,18 @@ def multi_tensor_novograd(
 # ---------------------------------------------------------------------------------
 
 
-def multi_tensor_lamb(
-    grads, params, exp_avgs, exp_avg_sqs, *, lr, beta1: float = 0.9,
+def lamb_flat(
+    gf, pf, mf, vf, spec: ArenaSpec, *, lr, beta1: float = 0.9,
     beta2: float = 0.999, eps: float = 1e-6, step=1, bias_correction: bool = True,
     weight_decay: float = 0.0, grad_averaging: bool = True, mode: int = 1,
     global_grad_norm=None, max_grad_norm: float = 1.0, use_nvlamb: bool = False,
-    found_inf=None, impl: Optional[str] = None, _sharded_norms=None,
+    found_inf=None, model_copy_dtype=None, impl: Optional[str] = None,
+    _sharded_norms=None,
 ):
-    """Fused LAMB. Returns (params, m, v).
-
-    Stage 1 computes the Adam-style update; per-tensor ``||p||``/``||u||`` trust
-    ratios then rescale the lr per tensor (nvlamb: for every tensor; otherwise
-    only tensors with weight decay — ref: multi_tensor_lamb.cu:255-263).
-
-    ``_sharded_norms``: (segment_ids_local, num_tensors, axis_name) — ZeRO
-    mode, where the tensor list is ONE arena shard and per-tensor norms must
-    be psum'd across the data axis (the DistributedFusedLAMB norm allreduce).
-    """
+    """Fused LAMB over pre-flattened arenas (see :func:`adam_flat` for why the
+    flat path exists). ``spec`` provides the static per-tensor segment table
+    for the trust-ratio norms. Returns (p, m, v[, model_copy])."""
     impl = _resolve(impl)
-    gf, spec = flatten(grads)
-    pf, _ = flatten(params)
-    mf, _ = flatten(exp_avgs)
-    vf, _ = flatten(exp_avg_sqs)
-
     bc1, bc2 = _bias_corrections(bias_correction, step, beta1, beta2)
     beta3 = (1.0 - beta1) if grad_averaging else 1.0
     if global_grad_norm is None:
@@ -461,12 +534,50 @@ def multi_tensor_lamb(
     coef = _segment_coef(ratio_pt, spec, seg_local)
 
     if impl == "pallas":
-        p_new = k.apply_scaled_update(pf, u, coef, found_inf=found_inf)
-    else:
-        p_new = p32 - coef * u
-        if found_inf is not None:
-            p_new = jnp.where(jnp.asarray(found_inf) != 0, p32, p_new)
-        p_new = p_new.astype(pf.dtype)
+        p_out = k.apply_scaled_update(
+            pf, u, coef, found_inf=found_inf, model_copy_dtype=model_copy_dtype
+        )
+        if model_copy_dtype is None:
+            return p_out, m_new, v_new
+        return p_out[0], m_new, v_new, p_out[1]
+    p_new = p32 - coef * u
+    if found_inf is not None:
+        p_new = jnp.where(jnp.asarray(found_inf) != 0, p32, p_new)
+    outs = (p_new.astype(pf.dtype), m_new, v_new)
+    if model_copy_dtype is not None:
+        outs = outs + (p_new.astype(model_copy_dtype),)
+    return outs
+
+
+def multi_tensor_lamb(
+    grads, params, exp_avgs, exp_avg_sqs, *, lr, beta1: float = 0.9,
+    beta2: float = 0.999, eps: float = 1e-6, step=1, bias_correction: bool = True,
+    weight_decay: float = 0.0, grad_averaging: bool = True, mode: int = 1,
+    global_grad_norm=None, max_grad_norm: float = 1.0, use_nvlamb: bool = False,
+    found_inf=None, impl: Optional[str] = None, _sharded_norms=None,
+):
+    """Fused LAMB. Returns (params, m, v).
+
+    Stage 1 computes the Adam-style update; per-tensor ``||p||``/``||u||`` trust
+    ratios then rescale the lr per tensor (nvlamb: for every tensor; otherwise
+    only tensors with weight decay — ref: multi_tensor_lamb.cu:255-263).
+
+    ``_sharded_norms``: (segment_ids_local, num_tensors, axis_name) — ZeRO
+    mode, where the tensor list is ONE arena shard and per-tensor norms must
+    be psum'd across the data axis (the DistributedFusedLAMB norm allreduce).
+    """
+    gf, spec = flatten(grads)
+    pf, _ = flatten(params)
+    mf, _ = flatten(exp_avgs)
+    vf, _ = flatten(exp_avg_sqs)
+    p_new, m_new, v_new = lamb_flat(
+        gf, pf, mf, vf, spec, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+        step=step, bias_correction=bias_correction, weight_decay=weight_decay,
+        grad_averaging=grad_averaging, mode=mode,
+        global_grad_norm=global_grad_norm, max_grad_norm=max_grad_norm,
+        use_nvlamb=use_nvlamb, found_inf=found_inf, impl=impl,
+        _sharded_norms=_sharded_norms,
+    )
     return unflatten(p_new, spec), unflatten(m_new, spec), unflatten(v_new, spec)
 
 

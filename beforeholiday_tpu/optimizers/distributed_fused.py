@@ -232,15 +232,17 @@ class DistributedFusedLAMB(_DistributedFused):
         return ("exp_avg", "exp_avg_sq")
 
     def _local_segment_ids(self, spec, shard):
-        """This rank's arena→tensor segment ids, computed O(shard): offsets are
-        a static sorted table, so searchsorted recovers the owning tensor of
-        each global index without ever materializing the full-arena table
-        (which would be an O(model) replicated buffer defeating the sharding)."""
+        """This rank's arena→tensor segment ids, computed O(shard * t): the
+        static boundary table recovers the owning tensor of each global index
+        without materializing the full-arena table (an O(model) replicated
+        buffer defeating the sharding). Uses the fused compare-sum from
+        ``arena.segment_ids_of`` — searchsorted's (N, 2) scan carry blows up
+        64x under TPU tiling."""
+        from beforeholiday_tpu.ops.arena import segment_ids_of
+
         rank = jax.lax.axis_index(self.axis_name)
         idx = rank * shard + jnp.arange(shard)
-        offsets = jnp.asarray(spec.offsets)
-        seg = jnp.searchsorted(offsets, idx, side="right") - 1
-        return jnp.where(idx < spec.total, seg, spec.num_tensors).astype(jnp.int32)
+        return segment_ids_of(spec, idx)
 
     def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
         lr = self.lr if lr is None else lr

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from beforeholiday_tpu.ops import multi_tensor as mt
+from beforeholiday_tpu.ops.arena import ArenaSpec, flatten as _arena_flatten, unflatten as _arena_unflatten
 from beforeholiday_tpu.ops._autocast import cast_floats as _cast_floats
 
 Mask = Union[None, Any, Callable[[Tuple[Any, ...]], bool]]
@@ -101,6 +102,48 @@ class _FusedOptimizer:
         if found_inf is None:
             return step + 1
         return jnp.where(jnp.asarray(found_inf) != 0, step, step + 1)
+
+    # ---- arena-resident (flat) API -------------------------------------------
+    #
+    # The list-based ``step`` re-packs params/grads/state into arenas EVERY call
+    # (one extra HBM round trip per tree per step — measured 2-3x the whole
+    # optimizer cost at 46M params on a v5e). State that lives flat pays the
+    # packing once at init. ``MasterWeights(..., arena=True)`` builds on this
+    # for the full amp O2/O5 step. Uniform weight decay only — per-leaf decay
+    # masks need the list API.
+
+    def init_flat(self, flat_params: jax.Array) -> Dict[str, Any]:
+        """State for one pre-flattened parameter arena."""
+        if type(self).step_flat is _FusedOptimizer.step_flat:
+            # fail at init, not after the caller has materialized (and maybe
+            # checkpointed) arena-shaped state the step can never consume —
+            # e.g. NovoGrad's second moments are per-tensor scalars, not flat
+            raise NotImplementedError(
+                f"{type(self).__name__} has no flat-arena step; use the "
+                "list-based init()/step()"
+            )
+        if self.no_weight_decay_mask is not None:
+            raise ValueError(
+                "no_weight_decay_mask is per-leaf; the flat-arena path applies "
+                "one decay to the whole arena — use the list-based step()"
+            )
+        state = {
+            key: jnp.zeros(flat_params.shape, self.state_dtype)
+            for key in self._state_keys()
+        }
+        state["step"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def step_flat(self, flat_params, flat_grads, state, *, spec=None,
+                  found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None):
+        """One fused step over pre-flattened arenas.
+
+        Returns ``(flat_params, state)``, plus a low-precision model copy
+        (same kernel pass, see ops.adam_flat) when ``model_copy_dtype`` is set.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} has no flat-arena step; use step()"
+        )
 
     def as_optax(self):
         """Adapter to an ``optax.GradientTransformation`` (fp32 use)."""
@@ -179,6 +222,23 @@ class FusedAdam(_FusedOptimizer):
             "step": step_no,
         }
 
+    def step_flat(self, flat_params, flat_grads, state, *, spec=None,
+                  found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None):
+        lr = self.lr if lr is None else lr
+        step_no = self._next_step(state, found_inf)
+        outs = mt.adam_flat(
+            flat_grads, flat_params, state["exp_avg"], state["exp_avg_sq"],
+            lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            step=step_no, adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction, weight_decay=self.weight_decay,
+            grad_scale=grad_scale, found_inf=found_inf,
+            model_copy_dtype=model_copy_dtype, impl=self.impl,
+        )
+        new_state = {"exp_avg": outs[1], "exp_avg_sq": outs[2], "step": step_no}
+        if model_copy_dtype is None:
+            return outs[0], new_state
+        return outs[0], new_state, outs[3]
+
 
 class FusedSGD(_FusedOptimizer):
     """Fused SGD with momentum/nesterov (ref: apex/optimizers/fused_sgd.py:6)."""
@@ -234,6 +294,24 @@ class FusedSGD(_FusedOptimizer):
 
         unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
         return unflat(new_p), {"momentum_buffer": unflat(new_b), "step": step_no}
+
+    def step_flat(self, flat_params, flat_grads, state, *, spec=None,
+                  found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None):
+        lr = self.lr if lr is None else lr
+        first_run = state["step"] == 0
+        step_no = self._next_step(state, found_inf)
+        outs = mt.sgd_flat(
+            flat_grads, flat_params, state["momentum_buffer"],
+            lr=lr, weight_decay=self.weight_decay, momentum=self.momentum,
+            dampening=self.dampening, nesterov=self.nesterov,
+            first_run=first_run, wd_after_momentum=self.wd_after_momentum,
+            scale=grad_scale, model_copy_dtype=model_copy_dtype,
+            found_inf=found_inf, impl=self.impl,
+        )
+        new_state = {"momentum_buffer": outs[1], "step": step_no}
+        if model_copy_dtype is None:
+            return outs[0], new_state
+        return outs[0], new_state, outs[2]
 
 
 class FusedAdagrad(_FusedOptimizer):
@@ -365,6 +443,36 @@ class FusedLAMB(_FusedOptimizer):
             "exp_avg_sq": unflat(new_v),
             "step": step_no,
         }
+
+    def step_flat(self, flat_params, flat_grads, state, *, spec=None,
+                  found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None,
+                  global_grad_norm=None):
+        """``global_grad_norm``: pass the all-bucket norm when the full
+        parameter set spans several arenas (MasterWeights arena mode computes
+        it) — defaulting to this arena's own norm is only correct when the
+        arena IS the whole model."""
+        if spec is None:
+            raise ValueError("FusedLAMB.step_flat needs the ArenaSpec for its "
+                             "per-tensor trust-ratio norms")
+        lr = self.lr if lr is None else lr
+        step_no = self._next_step(state, found_inf)
+        # fold the inverse loss scale before the global-norm clip, as the list
+        # path does (grad_scale enters the norm there too)
+        gf = flat_grads.astype(jnp.float32) * grad_scale
+        outs = mt.lamb_flat(
+            gf, flat_params, state["exp_avg"], state["exp_avg_sq"], spec,
+            lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            step=step_no, bias_correction=self.bias_correction,
+            weight_decay=self.weight_decay, grad_averaging=self.grad_averaging,
+            mode=1 if self.adam_w_mode else 0, max_grad_norm=self.max_grad_norm,
+            use_nvlamb=self.use_nvlamb, found_inf=found_inf,
+            global_grad_norm=global_grad_norm,
+            model_copy_dtype=model_copy_dtype, impl=self.impl,
+        )
+        new_state = {"exp_avg": outs[1], "exp_avg_sq": outs[2], "step": step_no}
+        if model_copy_dtype is None:
+            return outs[0], new_state
+        return outs[0], new_state, outs[3]
 
 
 class FusedNovoGrad(_FusedOptimizer):
@@ -504,16 +612,46 @@ class MasterWeights:
     model leaf's dtype — the reference's lazy master creation +
     ``_master_params_to_model_params`` copy (:14-25), made explicit. Wraps any
     fused optimizer; used by amp O2/O5 and FusedMixedPrecisionLamb.
+
+    ``arena=True`` keeps the fp32 masters AND the inner optimizer state packed
+    as flat arenas (one per model dtype, mirroring the reference's fp16/fp32
+    list bucketing, apex/optimizers/fused_adam.py:149-180): the per-step work
+    becomes one grad flatten + one fused kernel pass that emits the new masters
+    and the low-precision model copy together — no per-step re-packing of
+    params/m/v and no separate master->model cast pass. Single-device / manual
+    shard_map use; under GSPMD auto-sharding keep the tree form.
     """
 
-    def __init__(self, inner):
+    def __init__(self, inner, *, arena: bool = False):
         self.inner = inner
+        self.arena = arena
+
+    # dtype buckets, derived from the (static) param tree every call — no
+    # hidden instance state, so step() stays pure under jit
+    @staticmethod
+    def _bucket_layout(leaves):
+        buckets: Dict[Any, List[int]] = {}
+        for i, p in enumerate(leaves):
+            buckets.setdefault(jnp.dtype(p.dtype), []).append(i)
+        return sorted(buckets.items(), key=lambda kv: kv[0].name)
 
     def init(self, params):
-        master = _cast_floats(params, jnp.float32)
-        return {"inner": self.inner.init(master), "master": master}
+        if not self.arena:
+            master = _cast_floats(params, jnp.float32)
+            return {"inner": self.inner.init(master), "master": master}
+        leaves = jax.tree_util.tree_leaves(params)
+        masters, inners = [], []
+        for dtype, idx in self._bucket_layout(leaves):
+            mf, _ = _arena_flatten([leaves[i] for i in idx], dtype=jnp.float32)
+            masters.append(mf)
+            inners.append(self.inner.init_flat(mf))
+        return {"inner": tuple(inners), "master": tuple(masters)}
 
     def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
+        if self.arena:
+            return self._step_arena(
+                params, grads, state, found_inf=found_inf, grad_scale=grad_scale, **kw
+            )
         master = state["master"]
         grads32 = _cast_floats(grads, jnp.float32)
         new_master, new_inner = self.inner.step(
@@ -525,6 +663,50 @@ class MasterWeights:
             new_master, params,
         )
         return new_params, {"inner": new_inner, "master": new_master}
+
+    def _step_arena(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
+        import inspect
+
+        pleaves, treedef = jax.tree_util.tree_flatten(params)
+        gleaves = jax.tree_util.tree_leaves(grads)
+        if len(pleaves) != len(gleaves):
+            raise ValueError(
+                f"params/grads leaf mismatch: {len(pleaves)} vs {len(gleaves)}"
+            )
+        layout = self._bucket_layout(pleaves)
+        flat_grads = [
+            _arena_flatten([gleaves[i] for i in idx]) for _, idx in layout
+        ]
+        # norm-clipping optimizers (LAMB) need ONE global grad norm across
+        # every dtype bucket — per-bucket norms would clip each bucket by its
+        # own magnitude and silently diverge from the list path on the
+        # standard bf16+keep-fp32-norms layout
+        extra = {}
+        if "global_grad_norm" in inspect.signature(self.inner.step_flat).parameters:
+            total_sq = sum(
+                jnp.sum((gf.astype(jnp.float32) * grad_scale) ** 2)
+                for gf, _ in flat_grads
+            )
+            extra["global_grad_norm"] = jnp.sqrt(total_sq)
+
+        new_leaves = list(pleaves)
+        masters, inners = [], []
+        for b, (dtype, idx) in enumerate(layout):
+            # grads keep the model dtype — the kernel casts in-register
+            gf, spec = flat_grads[b]
+            copy_dtype = None if dtype == jnp.float32 else dtype
+            outs = self.inner.step_flat(
+                state["master"][b], gf, state["inner"][b], spec=spec,
+                found_inf=found_inf, grad_scale=grad_scale,
+                model_copy_dtype=copy_dtype, **extra, **kw,
+            )
+            masters.append(outs[0])
+            inners.append(outs[1])
+            model_flat = outs[2] if copy_dtype is not None else outs[0]
+            for i, piece in zip(idx, _arena_unflatten(model_flat, spec)):
+                new_leaves[i] = piece
+        new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return new_params, {"inner": tuple(inners), "master": tuple(masters)}
 
     def master_params(self, state):
         """Iterator over master leaves (ref: apex/amp/_amp_state.py master_params)."""

@@ -191,14 +191,36 @@ def _pipelined_fwd_bwd(
         act_store, fwd_reg, bwd_reg, g_stage, g_embed, g_head, loss_acc = carry
 
         # ---- forward slot (named scopes surface in XProf like NVTX ranges) --------
+        # Bubble slots and the embed are lax.cond-gated: HLO ``conditional``
+        # executes only the taken branch, so idle ticks skip the stage matmuls
+        # and the embedding runs ONLY on the first logical stage (it used to
+        # run on every device every tick — pure waste at S x total_ticks
+        # scale). Safe because every predicate depends only on (t, pipe rank):
+        # peers along tensor/data/context axes take the same branch, so
+        # stage_fn-internal collectives cannot diverge. stage_fn must not
+        # carry PIPE-axis collectives (the rings below are the pipe traffic).
         with jax.named_scope("pp_forward_slot"):
             f_valid, m_f, v_f, tf_f = decompose_f(t)
             sp_f = chunk_of(v_f)
             is_first_logical = is_first_dev & (v_f == 0)
-            x_raw = inputs[m_f]
-            x_embedded = run_embed(embed_params, x_raw)
-            x_in = jnp.where(is_first_logical, x_embedded, fwd_reg).astype(hidden_dtype)
-            y = stage_fn(sp_f, x_in)
+
+            def fwd_compute():
+                # embed only on the first logical stage (inner cond): all
+                # other stages take the ring register. The inputs[m_f] gather
+                # stays INSIDE the branch — a value closed over by a cond is
+                # computed unconditionally
+                x_in = jax.lax.cond(
+                    is_first_logical,
+                    lambda: run_embed(embed_params, inputs[m_f]).astype(hidden_dtype),
+                    lambda: fwd_reg.astype(hidden_dtype),
+                )
+                return x_in, stage_fn(sp_f, x_in).astype(hidden_dtype)
+
+            def fwd_idle():
+                z = jnp.zeros(hidden_shape, hidden_dtype)
+                return z, z
+
+            x_in, y = jax.lax.cond(f_valid, fwd_compute, fwd_idle)
             slot_f = tf_f % ring_depth
             act_store = jnp.where(
                 f_valid,
@@ -239,9 +261,21 @@ def _pipelined_fwd_bwd(
             dsp, dx = vjp(bwd_reg.astype(hidden_dtype))
             return jnp.float32(0.0), dsp, zeros_head_g, dx
 
+        def idle_branch():
+            # bubble slot: skip the recompute+VJP entirely (cond, not select —
+            # see the forward-slot note on branch-divergence safety)
+            return (
+                jnp.float32(0.0),
+                jax.tree.map(jnp.zeros_like, sp_b),
+                zeros_head_g,
+                jnp.zeros(hidden_shape, hidden_dtype),
+            )
+
         with jax.named_scope("pp_backward_slot"):
             mb_loss, dsp, dhp, dx = jax.lax.cond(
-                is_last_logical, last_branch, inner_branch
+                b_valid,
+                lambda: jax.lax.cond(is_last_logical, last_branch, inner_branch),
+                idle_branch,
             )
 
         loss_acc = loss_acc + jnp.where(b_valid & is_last_logical, mb_loss, 0.0)
@@ -260,9 +294,21 @@ def _pipelined_fwd_bwd(
         if head_fn is not None:
             g_head = _acc_tree(g_head, b_valid & is_last_logical, dhp)
         if embed_fn is not None:
-            # pull dx through the embedding on the first logical stage
-            _, vjp_e = jax.vjp(lambda ep: run_embed(ep, inputs[m_b]), embed_params)
-            (dep,) = vjp_e(jnp.where(is_first_logical_b, dx, 0.0).astype(hidden_dtype))
+            # pull dx through the embedding — only where it is actually
+            # needed (valid backward slot on the first logical stage); other
+            # ranks/ticks skip the embed recompute+VJP via cond
+            def embed_grad():
+                _, vjp_e = jax.vjp(
+                    lambda ep: run_embed(ep, inputs[m_b]), embed_params
+                )
+                (dep,) = vjp_e(dx.astype(hidden_dtype))
+                return dep
+
+            dep = jax.lax.cond(
+                b_valid & is_first_logical_b,
+                embed_grad,
+                lambda: zeros_embed_g,
+            )
             g_embed = _acc_tree(g_embed, b_valid & is_first_logical_b, dep)
 
         # ---- rings ---------------------------------------------------------------

@@ -6,7 +6,16 @@ the TPU-native default) vs the self-generated O0 fp32 baseline on the same
 hardware — the reference publishes no numbers (BASELINE.md), so the baseline
 is config 1 run here. vs_baseline > 1.0 = amp wins.
 
-Secondary (in detail): fused multi-tensor Adam step vs unfused optax.adamw.
+Methodology notes (this chip sits behind a high-latency shared tunnel):
+
+* One scalar device->host readback (~90 ms) fences N chained async dispatches;
+  timings NEVER ``device_get`` a tensor (a 32 MB fetch through the tunnel costs
+  seconds and poisoned the r03 flash/chip-peak numbers).
+* The chip's effective throughput drifts +-20-30% minute to minute (shared
+  tenancy), so every A-vs-B ratio is the MEDIAN OF PAIRED RATIOS: A and B are
+  timed back-to-back per pair, several pairs per metric.
+* The chip-peak probe runs a dependent-chain matmul loop in ONE dispatch
+  (``lax.fori_loop``) so per-dispatch tunnel latency cannot dilute it.
 """
 
 from __future__ import annotations
@@ -19,49 +28,98 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _force(tree):
+    """Fence device execution: reduce ONE leaf to a scalar on device and fetch
+    4 bytes. Execution is in-order, so the last result's readback fences all.
+    Never device_get a full array here (see module docstring)."""
+    leaf = jax.tree.leaves(tree)[-1]
+    return float(jax.device_get(jnp.sum(leaf.astype(jnp.float32))))
+
+
 _LATENCY = None
 
 
 def _readback_latency() -> float:
-    """One-scalar device->host round trip. The axon tunnel's block_until_ready
-    returns early, so ALL timing here chains N async dispatches and forces one
-    readback, subtracting this latency."""
+    """The one-scalar device->host round trip (~90 ms via the tunnel). Every
+    _time_once pays it exactly once; without subtracting it a millisecond-
+    scale op reads as latency, and paired RATIOS compress toward 1 —
+    (A+L)/(B+L) != A/B."""
     global _LATENCY
     if _LATENCY is None:
-        x = jnp.float32(1.0)
         f = jax.jit(lambda x: x + 1)
-        float(f(x))
+        x = jnp.float32(1.0)
+        _force(f(x))
         ts = []
         for _ in range(5):
             t0 = time.perf_counter()
-            float(f(x))
+            _force(f(x))
             ts.append(time.perf_counter() - t0)
         _LATENCY = float(np.median(ts))
     return _LATENCY
 
 
-def _time_it(fn, args, iters=30):
-    """Median-free amortized timing: N chained async steps + one readback."""
-    out = fn(*args)  # compile
-    _force(out)
+def _time_once(fn, args, iters):
+    """N chained async dispatches + one scalar readback, already compiled;
+    the readback round trip is measured separately and subtracted."""
     lat = _readback_latency()
     t0 = time.perf_counter()
+    out = None
     for _ in range(iters):
         out = fn(*args)
     _force(out)
-    total = time.perf_counter() - t0
-    return max(total - lat, 1e-9) / iters
+    return max(time.perf_counter() - t0 - lat, 1e-9) / iters
 
 
-def _force(tree):
-    """Host-readback of one scalar depending on every leaf? One leaf suffices:
-    device execution is in-order, so the LAST result's readback fences all."""
-    leaf = jax.tree.leaves(tree)[-1]
-    np.asarray(jax.device_get(leaf)).ravel()[:1]
+def _time_it(fn, args, iters=30, reps=3):
+    """Best-of-reps amortized time for one function (compiles first)."""
+    _force(fn(*args))
+    return min(_time_once(fn, args, iters) for _ in range(reps))
+
+
+def _paired_ratio(fn_a, args_a, fn_b, args_b, pairs=8, iters=10):
+    """Median of per-pair (time_a / time_b) with A/B timed back-to-back.
+    Returns (ratio_a_over_b, median_a_seconds, median_b_seconds)."""
+    _force(fn_a(*args_a))
+    _force(fn_b(*args_b))
+    tas, tbs = [], []
+    for _ in range(pairs):
+        tas.append(_time_once(fn_a, args_a, iters))
+        tbs.append(_time_once(fn_b, args_b, iters))
+    ratios = [ta / tb for ta, tb in zip(tas, tbs)]
+    return float(np.median(ratios)), float(np.median(tas)), float(np.median(tbs))
+
+
+def bench_chip_peak(n: int = 16384, loop: int = 10):
+    """Achievable bf16 matmul TFLOP/s: a dependent matmul chain inside one
+    jitted fori_loop (one dispatch), scalar-fenced. At n=16384 this reads
+    ~165 TFLOP/s on an idle v5e (nominal ~197) — the MFU denominator.
+    Also probes effective HBM GB/s with a 1-GiB triad loop."""
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm_loop(a, b):
+        # *0.999 keeps values bounded and defeats loop-invariant hoisting
+        return jax.lax.fori_loop(0, loop, lambda i, o: (a @ o) * 0.999, b)
+
+    dt = _time_it(mm_loop, (a, b), iters=1, reps=2) / loop
+    tflops = 2 * n**3 / dt / 1e12
+
+    n_el = 192 * 1024 * 1024
+    x = jnp.ones((n_el,), jnp.float32)
+    y = jnp.ones((n_el,), jnp.float32)
+
+    @jax.jit
+    def triad(x, y):
+        return jax.lax.fori_loop(0, loop, lambda i, y: y * 0.999 + x, y)
+
+    dt = _time_it(triad, (x, y), iters=1, reps=2) / loop
+    gbs = 3 * n_el * 4 / dt / 1e9
+    return tflops, gbs
 
 
 def bench_resnet50(opt_level: str, batch: int = 128, iters: int = 30) -> float:
-    """Median step time (s) for one synthetic ImageNet train step."""
+    """Amortized step time (s) for one synthetic ImageNet train step."""
     import os
     import sys
 
@@ -81,20 +139,19 @@ def bench_resnet50(opt_level: str, batch: int = 128, iters: int = 30) -> float:
     state = (trainer.params, trainer.opt_state, trainer.scaler_state, trainer.bn_state)
     out = trainer.train_step(*state, images, labels, lr)  # compile
     _force(out)
-    lat = _readback_latency()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = trainer.train_step(*out[:4], images, labels, lr)
-    _force(out)
-    total = time.perf_counter() - t0
-    return max(total - lat, 1e-9) / iters
+
+    def step(*s):
+        return trainer.train_step(*s, images, labels, lr)[:4]
+
+    return _time_it(step, out[:4], iters=iters, reps=2)
 
 
-def bench_flash_attention(S: int = 8192, iters: int = 5):
+def bench_flash_attention(S: int = 8192, pairs: int = 4, iters: int = 3):
     """Pallas flash attention vs the materialized-scores softmax path at long
-    sequence (VERDICT r2 item 3). At S=8192 the unfused backward does not even
-    compile on one chip (the (B*H, S, S) probs tensor), so the comparison is
-    forward-only; the kernel's other win is enabling the long-context bwd."""
+    sequence. At S=8192 the unfused path materializes (B*H, S, S) score/prob
+    tensors (~13 GB of HBM traffic/step vs flash's ~0.2 GB) and its backward
+    does not even compile on one chip; the comparison is forward-only.
+    Returns (ratio_unfused_over_flash, flash_s, unfused_s)."""
     from beforeholiday_tpu.ops import attention as A
     from beforeholiday_tpu.ops import scaled_upper_triang_masked_softmax
 
@@ -112,9 +169,10 @@ def bench_flash_attention(S: int = 8192, iters: int = 5):
         probs = scaled_upper_triang_masked_softmax(scores, sc)
         return probs.astype(q.dtype).reshape(B, H, S, S) @ v
 
-    flash_s = _time_it(flash, (q, k, v), iters=iters)
-    unfused_s = _time_it(jax.jit(unfused), (q, k, v), iters=iters)
-    return flash_s, unfused_s
+    ratio, unfused_s, flash_s = _paired_ratio(
+        jax.jit(unfused), (q, k, v), flash, (q, k, v), pairs=pairs, iters=iters
+    )
+    return ratio, flash_s, unfused_s
 
 
 def _first_candidate(candidates, run_one, label):
@@ -133,50 +191,51 @@ def _first_candidate(candidates, run_one, label):
     return None, "all_failed"
 
 
-def bench_bert_lamb(iters: int = 3):
+def bench_bert_lamb(iters: int = 5):
     """BERT + FusedLAMB pretraining step (BASELINE config 4; ref:
     apex/transformer/testing/standalone_bert.py:255 + DistributedFusedLAMB's
-    MLPerf recipe). Tries geometries largest-first: the full BERT-Large state
-    (~1.3 GB fp32) exceeds this tunnel's ~1 GB compile-payload limit
-    (HTTP 413), so the largest config that actually compiles is reported,
-    tagged in the detail dict. Returns (step_seconds, tag)."""
+    MLPerf recipe). Geometries tried largest-first under the tunnel's
+    ~1 GB compile-payload limit. Returns ((step_seconds, flops_per_step), tag)."""
     from beforeholiday_tpu.optimizers import FusedLAMB
     from beforeholiday_tpu.testing import bert
 
     candidates = [
+        ("bert_large_8layer", bert.bert_large(seq_len=128, n_layers=8,
+                                              dtype=jnp.bfloat16)),
         ("bert_large_4layer", bert.bert_large(seq_len=128, n_layers=4,
                                               dtype=jnp.bfloat16)),
         ("bert_512x8_4layer", bert.BertConfig(
             vocab_size=30522, seq_len=128, d_model=512, n_heads=8, n_layers=4,
             dtype=jnp.bfloat16)),
-        ("bert_512x8_4layer_v8k", bert.BertConfig(
-            vocab_size=8192, seq_len=128, d_model=512, n_heads=8, n_layers=4,
-            dtype=jnp.bfloat16)),
         ("bert_256x4_2layer", bert.BertConfig(
             vocab_size=8192, seq_len=128, d_model=256, n_heads=4, n_layers=2,
             dtype=jnp.bfloat16)),
     ]
+    batch = 8
+
     def run_one(cfg):
         params = bert.init(jax.random.PRNGKey(0), cfg)
-        batch = bert.synthetic_batch(jax.random.PRNGKey(1), cfg, 8)
+        batch_data = bert.synthetic_batch(jax.random.PRNGKey(1), cfg, batch)
         opt = FusedLAMB(lr=1e-3, weight_decay=0.01)
         state = opt.init(params)
 
         @jax.jit
         def step(p, s):
-            loss, g = jax.value_and_grad(bert.pretrain_loss)(p, *batch, cfg)
+            loss, g = jax.value_and_grad(bert.pretrain_loss)(p, *batch_data, cfg)
             p, s = opt.step(p, g, s)
             return p, s, loss
 
-        return _time_it(lambda p, s: step(p, s), (params, state), iters=iters)
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        t = _time_it(lambda p, s: step(p, s), (params, state), iters=iters, reps=2)
+        return t, 6.0 * n_params * batch * cfg.seq_len
 
     return _first_candidate(candidates, run_one, "bert")
 
 
-def bench_gpt_train(iters: int = 5):
-    """Flagship GPT training step (BASELINE config 5 shape): amp O5 + flash
-    attention + FusedAdam, single chip. Geometries tried largest-first under
-    the tunnel's compile-payload limit. Returns (step_s, tokens, tag)."""
+def bench_gpt_train(iters: int = 10):
+    """Flagship GPT training step (BASELINE config 5 shape): amp O5 with
+    ARENA-RESIDENT fp32 masters + flash attention + FusedAdam, single chip.
+    Returns ((step_s, tokens, flops_per_step), tag)."""
     from beforeholiday_tpu import amp
     from beforeholiday_tpu.optimizers import FusedAdam
     from beforeholiday_tpu.testing import gpt
@@ -196,7 +255,7 @@ def bench_gpt_train(iters: int = 5):
         tokens, targets = gpt.synthetic_batch(jax.random.PRNGKey(1), cfg, batch)
         m = amp.initialize(
             lambda p, t: gpt.forward(p, t, cfg), params,
-            FusedAdam(lr=1e-4), "O5",
+            FusedAdam(lr=1e-4), "O5", arena_masters=True,
         )
 
         def loss_fn(p, tok, tgt):
@@ -212,52 +271,144 @@ def bench_gpt_train(iters: int = 5):
             p, o = m.optimizer.step(p, g, o, found_inf=fi)
             return p, o, s, loss
 
+        n_params = sum(x.size for x in jax.tree.leaves(params))
         t = _time_it(lambda p, o, s: step(p, o, s),
-                     (m.params, opt_state, sstate), iters=iters)
-        return t, batch * cfg.seq_len
+                     (m.params, opt_state, sstate), iters=iters, reps=2)
+        return t, batch * cfg.seq_len, 6.0 * n_params * batch * cfg.seq_len
 
     res, tag = _first_candidate(candidates, run_one, "gpt")
     if res is None:
-        return None, 0, tag
-    return res[0], res[1], tag
+        return None, tag
+    return res, tag
 
 
-def bench_fused_adam():
-    from beforeholiday_tpu.ops import multi_tensor_adam
+def bench_fused_adam(pairs: int = 8, iters: int = 10):
+    """Fused arena-resident Adam vs unfused optax.adamw, paired.
+
+    Two comparisons, both reflecting shipped code paths:
+
+    * fp32 optimizer step, state in each side's native layout — FusedAdam with
+      arena-resident state + pre-flattened grads (what the arena-masters amp
+      path delivers) vs optax.adamw over the param tree.
+    * the realistic amp O2/O5 master-weight step — MasterWeights(FusedAdam,
+      arena=True) on a bf16 model (one fused kernel pass emits fp32 masters
+      AND the bf16 model copy) vs the equivalent optax chain (cast grads,
+      adamw on fp32 masters, cast params back to bf16).
+    """
     import optax
+    from beforeholiday_tpu.optimizers import FusedAdam, MasterWeights
+    from beforeholiday_tpu.ops.arena import flatten
 
-    def _param_set(key):
+    def _param_set(key, dtype=jnp.float32):
         shapes = (
             [(1024, 1024)] * 12 + [(4096, 1024)] * 3 + [(1024, 4096)] * 3
             + [(30522, 256)] + [(1024,)] * 48
         )
         keys = jax.random.split(key, len(shapes))
-        return [jax.random.normal(k, s, jnp.float32) * 0.02 for k, s in zip(keys, shapes)]
+        return {f"p{i}": jax.random.normal(k, s, dtype) * 0.02
+                for i, (k, s) in enumerate(zip(keys, shapes))}
 
+    hp = dict(lr=1e-3, weight_decay=0.01)
+    opt = optax.adamw(learning_rate=hp["lr"], b1=0.9, b2=0.999, eps=1e-8,
+                      weight_decay=hp["weight_decay"])
+
+    # --- fp32: arena-resident fused vs tree optax ---
+    # The drop-in rung flattens the grad tree INSIDE the timed step — that is
+    # what the shipped arena path (MasterWeights._step_arena) pays per step.
+    # The kernel-only rung times pre-flattened grads: the cost floor a
+    # flat-gradient training loop would see, labeled separately.
     params = _param_set(jax.random.PRNGKey(0))
     grads = _param_set(jax.random.PRNGKey(1))
-    m = [jnp.zeros_like(p) for p in params]
-    v = [jnp.zeros_like(p) for p in params]
-    hp = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, step=1,
-              adam_w_mode=True, weight_decay=0.01)
+    pf, _ = flatten(list(params.values()))
+    gf, _ = flatten(list(grads.values()))
+    fused = FusedAdam(**hp)
+    fstate = fused.init_flat(pf)
 
     @jax.jit
-    def fused_step(grads, params, m, v):
-        return multi_tensor_adam(grads, params, m, v, **hp)
+    def fused_step(p, gtree, s):
+        gflat, _ = flatten(list(gtree.values()))
+        return fused.step_flat(p, gflat, s)
 
-    fused_s = _time_it(fused_step, (grads, params, m, v))
+    fused_kernel_step = jax.jit(lambda p, g, s: fused.step_flat(p, g, s))
 
-    opt = optax.adamw(learning_rate=hp["lr"], b1=hp["beta1"], b2=hp["beta2"],
-                      eps=hp["eps"], weight_decay=hp["weight_decay"])
-    opt_state = opt.init(params)
+    ost = opt.init(params)
 
     @jax.jit
-    def optax_step(grads, params, opt_state):
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state
+    def optax_step(g, p, o):
+        updates, o = opt.update(g, o, p)
+        return optax.apply_updates(p, updates), o
 
-    optax_s = _time_it(optax_step, (grads, params, opt_state))
-    return fused_s, optax_s
+    r32, optax_s, fused_s = _paired_ratio(
+        optax_step, (grads, params, ost), fused_step, (pf, grads, fstate),
+        pairs=pairs, iters=iters,
+    )
+    rk, _, kernel_s = _paired_ratio(
+        optax_step, (grads, params, ost), fused_kernel_step, (pf, gf, fstate),
+        pairs=max(pairs // 2, 3), iters=iters,
+    )
+
+    # --- O5 master-weights step on a bf16 model ---
+    model = _param_set(jax.random.PRNGKey(0), jnp.bfloat16)
+    g_bf = _param_set(jax.random.PRNGKey(1), jnp.bfloat16)
+    mw = MasterWeights(FusedAdam(**hp), arena=True)
+    mw_state = mw.init(model)
+    fi = jnp.float32(0.0)
+    inv_scale = 1.0 / 65536
+    mw_step = jax.jit(lambda p, g, s: mw.step(p, g, s, found_inf=fi,
+                                              grad_scale=inv_scale))
+
+    master32 = _param_set(jax.random.PRNGKey(0))
+    ost5 = opt.init(master32)
+
+    @jax.jit
+    def optax_o5(g_bf, master, o):
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32) * inv_scale, g_bf)
+        updates, o = opt.update(g32, o, master)
+        master = optax.apply_updates(master, updates)
+        modelp = jax.tree.map(lambda p: p.astype(jnp.bfloat16), master)
+        return master, o, modelp
+
+    r5, _, o5_s = _paired_ratio(
+        optax_o5, (g_bf, master32, ost5), mw_step, (model, g_bf, mw_state),
+        pairs=pairs, iters=iters,
+    )
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    return dict(
+        n_params=n_params,
+        fused_adam_ms=fused_s * 1e3,
+        optax_ms=optax_s * 1e3,
+        fused_adam_vs_optax=r32,
+        fused_adam_kernel_ms=kernel_s * 1e3,
+        fused_adam_kernel_vs_optax=rk,
+        fused_adam_o5_ms=o5_s * 1e3,
+        fused_adam_o5_vs_optax=r5,
+    )
+
+
+def bench_pp_overhead():
+    """1F1B schedule overhead vs sequential grad accumulation, measured on a
+    virtual 8-CPU mesh in a subprocess (the chip behind the tunnel is a
+    single device; the schedule tax — bubbles + backward recompute — is a
+    total-work property the CPU mesh exposes fine). The child env scrubs the
+    axon vars: the sitecustomize otherwise force-registers the TPU backend
+    and the 'CPU mesh' silently becomes one device."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.pp_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"pp_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def _stage(detail, fn, *args):
@@ -270,24 +421,22 @@ def _stage(detail, fn, *args):
         return None
 
 
-def bench_chip_calibration(n: int = 4096, iters: int = 20) -> float:
-    """Raw bf16 matmul TFLOP/s — a normalizer for the other numbers: the
-    tunneled chip's effective throughput swings several-fold between runs
-    (observed 0.8-1.0 TFLOP/s vs ~100 nominal for a v5e), so absolute
-    step times only mean something next to this figure."""
-    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
-    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
-    f = jax.jit(lambda a, b: a @ b)
-    dt = _time_it(f, (a, b), iters=iters)
-    return 2 * n**3 / dt / 1e12
-
-
 def main():
     batch = 128
     detail = {"backend": jax.default_backend(), "global_batch": batch}
-    tflops = _stage(detail, bench_chip_calibration)
-    if tflops:
-        detail["chip_matmul_bf16_tflops"] = round(tflops, 2)
+
+    peak = _stage(detail, bench_chip_peak)
+    peak_tflops = None
+    if peak:
+        peak_tflops, hbm_gbs = peak
+        detail["chip_peak_bf16_tflops"] = round(peak_tflops, 1)
+        detail["chip_hbm_gbs"] = round(hbm_gbs, 0)
+
+    def mfu(model_flops, dt):
+        if not (peak_tflops and dt):
+            return None
+        return round(model_flops / dt / 1e12 / peak_tflops, 4)
+
     o5_s = _stage(detail, bench_resnet50, "O5", batch)
     o0_s = _stage(detail, bench_resnet50, "O0", batch)
     if o5_s:
@@ -296,36 +445,54 @@ def main():
         detail["o0_fp32_step_ms"] = round(o0_s * 1e3, 2)
         detail["o0_img_per_s"] = round(batch / o0_s, 1)
     if o5_s:
-        # effective model FLOP rate (ResNet-50 fwd+bwd ~ 3x 4.1 GFLOP/img):
-        # at 56 ms/step this is ~28 TFLOP/s — i.e. real v5e-class throughput,
-        # while the single-matmul calibration above reads ~1 TFLOP/s; the
-        # tunnel distorts small/isolated dispatches far more than big fused
-        # programs, so model-level numbers are the trustworthy ones here
-        detail["resnet_o5_model_tflops"] = round(3 * 4.1e9 * batch / o5_s / 1e12, 2)
+        # ResNet-50 fwd+bwd ~ 3x 4.1 GFLOP/img
+        rn_flops = 3 * 4.1e9 * batch
+        detail["resnet_o5_model_tflops"] = round(rn_flops / o5_s / 1e12, 2)
+        m = mfu(rn_flops, o5_s)
+        if m:
+            detail["resnet_o5_mfu"] = m
 
     adam = _stage(detail, bench_fused_adam)
     if adam:
-        detail["fused_adam_46M_ms"] = round(adam[0] * 1e3, 3)
-        detail["fused_adam_vs_optax"] = round(adam[1] / adam[0], 3)
+        detail["fused_adam_46M_ms"] = round(adam["fused_adam_ms"], 3)
+        detail["fused_adam_vs_optax"] = round(adam["fused_adam_vs_optax"], 3)
+        detail["fused_adam_kernel_ms"] = round(adam["fused_adam_kernel_ms"], 3)
+        detail["fused_adam_kernel_vs_optax"] = round(adam["fused_adam_kernel_vs_optax"], 3)
+        detail["fused_adam_o5_ms"] = round(adam["fused_adam_o5_ms"], 3)
+        detail["fused_adam_o5_vs_optax"] = round(adam["fused_adam_o5_vs_optax"], 3)
 
     attn = _stage(detail, bench_flash_attention)
     if attn:
-        detail["flash_attn_s8192_fwd_ms"] = round(attn[0] * 1e3, 2)
-        detail["flash_attn_vs_unfused_fwd"] = round(attn[1] / attn[0], 3)
+        ratio, flash_s, unfused_s = attn
+        detail["flash_attn_s8192_fwd_ms"] = round(flash_s * 1e3, 2)
+        detail["flash_attn_vs_unfused_fwd"] = round(ratio, 3)
         detail["flash_attn_note"] = (
             "unfused bwd uncompilable at S=8192; flash bwd runs"
         )
 
     bert_res = _stage(detail, bench_bert_lamb)
     if bert_res and bert_res[0]:
-        detail["bert_lamb_step_ms"] = round(bert_res[0] * 1e3, 2)
-        detail["bert_lamb_config"] = bert_res[1]
+        (t, flops), tag = bert_res
+        detail["bert_lamb_step_ms"] = round(t * 1e3, 2)
+        detail["bert_lamb_config"] = tag
+        m = mfu(flops, t)
+        if m:
+            detail["bert_lamb_mfu"] = m
+
+    pp_res = _stage(detail, bench_pp_overhead)
+    if pp_res:
+        detail["pp_overhead_vs_sequential"] = pp_res["pp_overhead_vs_sequential"]
+        detail["pp_1f1b_ms_cpu8"] = pp_res["pp_1f1b_ms"]
 
     gpt_res = _stage(detail, bench_gpt_train)
     if gpt_res and gpt_res[0]:
-        detail["gpt_o5_step_ms"] = round(gpt_res[0] * 1e3, 2)
-        detail["gpt_o5_tokens_per_s"] = round(gpt_res[1] / gpt_res[0], 1)
-        detail["gpt_config"] = gpt_res[2]
+        (t, tokens, flops), tag = gpt_res
+        detail["gpt_o5_step_ms"] = round(t * 1e3, 2)
+        detail["gpt_o5_tokens_per_s"] = round(tokens / t, 1)
+        detail["gpt_config"] = tag
+        m = mfu(flops, t)
+        if m:
+            detail["gpt_o5_mfu"] = m
 
     print(json.dumps({
         "metric": "resnet50_amp_O5_train",

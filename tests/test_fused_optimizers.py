@@ -308,3 +308,194 @@ class TestClipGradNorm:
         grads = {"a": jnp.asarray([1.0, -5.0, 2.0])}
         _, norm = clip_grad_norm_(grads, max_norm=1.0, norm_type=float("inf"))
         assert float(norm) == 5.0
+
+
+class TestArenaMode:
+    """Arena-resident (flat) optimizer paths vs the list-based trajectories."""
+
+    def _flat_params(self, seed=0):
+        from beforeholiday_tpu.ops.arena import flatten
+        params = _params(seed)
+        leaves = list(params.values())
+        return params, flatten(leaves)
+
+    def test_adam_step_flat_matches_tree_step(self):
+        from beforeholiday_tpu.ops.arena import flatten, unflatten
+
+        params, (pf, spec) = self._flat_params()
+        opt = FusedAdam(lr=1e-2, weight_decay=0.01)
+        tree_state = opt.init(params)
+        flat_state = opt.init_flat(pf)
+        rng = np.random.RandomState(3)
+        tree_p = params
+        for _ in range(5):
+            gnp = _grads_np(rng)
+            grads = {f"p{i}": jnp.asarray(g) for i, g in enumerate(gnp)}
+            gf, _ = flatten(list(grads.values()))
+            tree_p, tree_state = opt.step(tree_p, grads, tree_state)
+            pf, flat_state = opt.step_flat(pf, gf, flat_state)
+        for got, want in zip(unflatten(pf, spec), tree_p.values()):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+        assert int(flat_state["step"]) == int(tree_state["step"])
+
+    @pytest.mark.parametrize("impl", ["jnp", "pallas"])
+    def test_adam_step_flat_model_copy(self, impl):
+        _, (pf, spec) = self._flat_params()
+        gf = jnp.ones_like(pf) * 0.1
+        opt = FusedAdam(lr=1e-2, impl=impl)
+        state = opt.init_flat(pf)
+        pf2, state, copy = opt.step_flat(pf, gf, state, model_copy_dtype=jnp.bfloat16)
+        assert copy.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(copy), np.asarray(pf2.astype(jnp.bfloat16))
+        )
+
+    @pytest.mark.parametrize("impl", ["jnp", "pallas"])
+    def test_lamb_step_flat_model_copy(self, impl):
+        _, (pf, spec) = self._flat_params()
+        gf = jnp.ones_like(pf) * 0.1
+        opt = FusedLAMB(lr=1e-2, weight_decay=0.01, impl=impl)
+        state = opt.init_flat(pf)
+        pf2, state, copy = opt.step_flat(
+            pf, gf, state, spec=spec, model_copy_dtype=jnp.bfloat16
+        )
+        assert copy.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(copy), np.asarray(pf2.astype(jnp.bfloat16))
+        )
+
+    def test_lamb_step_flat_matches_tree_step(self):
+        from beforeholiday_tpu.ops.arena import flatten, unflatten
+
+        params, (pf, spec) = self._flat_params()
+        opt = FusedLAMB(lr=1e-2, weight_decay=0.01)
+        tree_state = opt.init(params)
+        flat_state = opt.init_flat(pf)
+        rng = np.random.RandomState(4)
+        tree_p = params
+        for _ in range(4):
+            gnp = _grads_np(rng)
+            grads = {f"p{i}": jnp.asarray(g) for i, g in enumerate(gnp)}
+            gf, _ = flatten(list(grads.values()))
+            tree_p, tree_state = opt.step(tree_p, grads, tree_state)
+            pf, flat_state = opt.step_flat(pf, gf, flat_state, spec=spec)
+        for got, want in zip(unflatten(pf, spec), tree_p.values()):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-5, atol=1e-7
+            )
+
+    def test_sgd_step_flat_matches_tree_step(self):
+        from beforeholiday_tpu.ops.arena import flatten, unflatten
+
+        params, (pf, spec) = self._flat_params()
+        opt = FusedSGD(lr=1e-2, momentum=0.9, weight_decay=1e-4)
+        tree_state = opt.init(params)
+        flat_state = opt.init_flat(pf)
+        rng = np.random.RandomState(5)
+        tree_p = params
+        for _ in range(4):
+            gnp = _grads_np(rng)
+            grads = {f"p{i}": jnp.asarray(g) for i, g in enumerate(gnp)}
+            gf, _ = flatten(list(grads.values()))
+            tree_p, tree_state = opt.step(tree_p, grads, tree_state)
+            pf, flat_state = opt.step_flat(pf, gf, flat_state)
+        for got, want in zip(unflatten(pf, spec), tree_p.values()):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+    def test_init_flat_rejects_decay_mask(self):
+        opt = FusedAdam(lr=1e-2, no_weight_decay_mask=lambda path: True)
+        with pytest.raises(ValueError, match="no_weight_decay_mask"):
+            opt.init_flat(jnp.zeros((arena_TILE(),), jnp.float32))
+
+    def test_master_weights_arena_matches_tree(self):
+        """Mixed-dtype model (bf16 + fp32 leaves), grad_scale and a skipped
+        step — the arena path must reproduce the tree MasterWeights exactly."""
+        from beforeholiday_tpu.optimizers import MasterWeights
+
+        rng = np.random.RandomState(7)
+        params = {
+            "w_bf16": jnp.asarray(rng.randn(8, 16).astype(np.float32)).astype(jnp.bfloat16),
+            "bn_fp32": jnp.asarray(rng.randn(16).astype(np.float32)),
+            "w2_bf16": jnp.asarray(rng.randn(16, 4).astype(np.float32)).astype(jnp.bfloat16),
+        }
+        mw_tree = MasterWeights(FusedAdam(lr=1e-2, weight_decay=0.01))
+        mw_arena = MasterWeights(FusedAdam(lr=1e-2, weight_decay=0.01), arena=True)
+        st_tree = mw_tree.init(params)
+        st_arena = mw_arena.init(params)
+        p_tree, p_arena = params, params
+        for step in range(5):
+            grads = jax.tree.map(
+                lambda p: jnp.asarray(rng.randn(*p.shape).astype(np.float32)).astype(p.dtype) * 512.0,
+                p_tree,
+            )
+            fi = jnp.float32(1.0 if step == 2 else 0.0)  # step 2 skipped
+            p_tree, st_tree = mw_tree.step(
+                p_tree, grads, st_tree, found_inf=fi, grad_scale=1.0 / 512.0
+            )
+            p_arena, st_arena = mw_arena.step(
+                p_arena, grads, st_arena, found_inf=fi, grad_scale=1.0 / 512.0
+            )
+        for key in params:
+            assert p_arena[key].dtype == params[key].dtype
+            np.testing.assert_allclose(
+                np.asarray(p_arena[key], np.float32),
+                np.asarray(p_tree[key], np.float32),
+                rtol=1e-6, atol=1e-7,
+            )
+        # masters advanced identically
+        tm = jax.tree_util.tree_leaves(st_tree["master"])
+        am = mw_arena.master_params(st_arena)
+        np.testing.assert_allclose(
+            sum(float(jnp.sum(x.astype(jnp.float32) ** 2)) for x in tm),
+            sum(float(jnp.sum(x.astype(jnp.float32) ** 2)) for x in am),
+            rtol=1e-5,
+        )
+
+    def test_master_weights_arena_lamb_global_clip(self):
+        """Mixed-dtype model + active grad-norm clipping: the arena path must
+        clip with the ONE global norm the tree path uses, not per-bucket."""
+        from beforeholiday_tpu.optimizers import MasterWeights
+
+        rng = np.random.RandomState(11)
+        params = {
+            "w_bf16": jnp.asarray(rng.randn(16, 8).astype(np.float32)).astype(jnp.bfloat16),
+            "ln_fp32": jnp.asarray(rng.randn(8).astype(np.float32)),
+        }
+        mk = lambda: FusedLAMB(lr=1e-2, weight_decay=0.01, max_grad_norm=0.5)
+        mw_tree = MasterWeights(mk())
+        mw_arena = MasterWeights(mk(), arena=True)
+        st_tree, st_arena = mw_tree.init(params), mw_arena.init(params)
+        p_tree, p_arena = params, params
+        for _ in range(3):
+            grads = jax.tree.map(
+                lambda p: jnp.asarray(
+                    rng.randn(*p.shape).astype(np.float32) * 3.0
+                ).astype(p.dtype),
+                p_tree,
+            )
+            p_tree, st_tree = mw_tree.step(p_tree, grads, st_tree)
+            p_arena, st_arena = mw_arena.step(p_arena, grads, st_arena)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(p_arena[k], np.float32),
+                np.asarray(p_tree[k], np.float32),
+                rtol=1e-5, atol=1e-6,
+            )
+
+    def test_master_weights_arena_under_jit(self):
+        from beforeholiday_tpu.optimizers import MasterWeights
+
+        params = {"a": jnp.ones((64,), jnp.bfloat16), "b": jnp.ones((32,), jnp.float32)}
+        mw = MasterWeights(FusedAdam(lr=1e-2), arena=True)
+        state = mw.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        step = jax.jit(lambda p, g, s: mw.step(p, g, s))
+        p2, state = step(params, grads, state)
+        p3, state = step(p2, grads, state)
+        assert p3["a"].dtype == jnp.bfloat16 and p3["b"].dtype == jnp.float32
+        assert float(jnp.mean(p3["a"].astype(jnp.float32))) < 1.0
+
+
+def arena_TILE():
+    from beforeholiday_tpu.ops.arena import TILE
+    return TILE
