@@ -74,11 +74,18 @@ def self_multihead_attn(
     causal: bool = False,
     key_padding_lens: Optional[jax.Array] = None,
     include_norm_add: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
     impl: Optional[str] = None,
 ) -> jax.Array:
     """x (B, S, E) → (B, S, E). ``include_norm_add`` = the norm_add variant:
     pre-LN before the projections, residual add after the output projection
-    (ref: fast_self_multihead_attn_norm_add_func.py)."""
+    (ref: fast_self_multihead_attn_norm_add_func.py).
+
+    ``dropout_rate``/``dropout_key``: attention-probability dropout, the
+    reference's ``dropout=`` constructor arg
+    (ref: self_multihead_attn.py:32, dropout.cuh) — softmax->dropout->matmul
+    ordering via ops.flash_attention."""
     B, S, E = x.shape
     h = x
     if include_norm_add:
@@ -95,7 +102,8 @@ def self_multihead_attn(
                 params["out_weight"].T,
                 params.get("out_bias"),
                 num_heads,
-                causal=causal, kv_lens=key_padding_lens, impl=impl,
+                causal=causal, kv_lens=key_padding_lens,
+                dropout_rate=dropout_rate, dropout_key=dropout_key, impl=impl,
             ),
             x, include_norm_add,
         )
@@ -113,7 +121,8 @@ def self_multihead_attn(
         _split_heads(q, B, S, num_heads),
         _split_heads(k, B, S, num_heads),
         _split_heads(v, B, S, num_heads),
-        causal=causal, kv_lens=key_padding_lens, impl=impl,
+        causal=causal, kv_lens=key_padding_lens,
+        dropout_rate=dropout_rate, dropout_key=dropout_key, impl=impl,
     )
     out = ctx.transpose(0, 2, 1, 3).reshape(B, S, E) @ params["out_weight"].T.astype(ctx.dtype)
     if "out_bias" in params:
@@ -151,6 +160,8 @@ def encdec_multihead_attn(
     *,
     key_padding_lens: Optional[jax.Array] = None,
     include_norm_add: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
     impl: Optional[str] = None,
 ) -> jax.Array:
     """Cross-attention (ref: encdec_multihead_attn.py): Q from the decoder
@@ -177,7 +188,8 @@ def encdec_multihead_attn(
         _split_heads(q, B, Sq, num_heads),
         _split_heads(k, B, Sk, num_heads),
         _split_heads(v, B, Sk, num_heads),
-        causal=False, kv_lens=key_padding_lens, impl=impl,
+        causal=False, kv_lens=key_padding_lens,
+        dropout_rate=dropout_rate, dropout_key=dropout_key, impl=impl,
     )
     out = ctx.transpose(0, 2, 1, 3).reshape(B, Sq, E) @ params["out_weight"].T.astype(
         ctx.dtype

@@ -351,7 +351,7 @@ _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 # ---------------------------------------------------------------------------------
 
 
-def _attn_jnp(q, k, v, lens, causal, scale):
+def _attn_jnp(q, k, v, lens, causal, scale, dropout_rate=0.0, dropout_key=None):
     BH, S, D = q.shape
     Sk = k.shape[1]
     s = jnp.einsum(
@@ -369,6 +369,11 @@ def _attn_jnp(q, k, v, lens, causal, scale):
     l = jnp.sum(e, axis=-1, keepdims=True)
     nonempty = l > 0.0
     p = jnp.where(nonempty, e / jnp.where(nonempty, l, 1.0), 0.0)
+    if dropout_rate > 0.0:
+        # softmax -> dropout -> @v, torch's ordering (the reference kernels
+        # drop the probabilities in-kernel, dropout.cuh); inverted scaling
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
@@ -385,6 +390,8 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     kv_lens: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
     impl: Optional[str] = None,
 ) -> jax.Array:
     """Fused scaled-dot-product attention.
@@ -393,6 +400,15 @@ def flash_attention(
     at index >= len are masked out (the reference fmha's variable-seqlen
     support, ref: apex/contrib/fmha/fmha.py:33-60, expressed padded-dense).
     Returns (B, H, S, D) in q's dtype. fp32 accumulation throughout.
+
+    ``dropout_rate``/``dropout_key``: attention-probability dropout in
+    torch's softmax->dropout->matmul order (ref:
+    apex/contrib/multihead_attn/self_multihead_attn.py:32 ``dropout=`` and
+    dropout.cuh). Currently served by the jnp path — a dropout request
+    dispatches there even on TPU (the Pallas kernel has no in-kernel PRNG
+    yet), so long-sequence training with attention dropout pays the
+    materialized-scores cost. Hidden/residual dropout (the dominant
+    regularizers) are elementwise and unaffected.
     """
     if q.ndim != 4:
         raise ValueError(f"expected (B, H, S, D) inputs, got {q.shape}")
@@ -410,8 +426,17 @@ def flash_attention(
             f"causal attention needs matching q/k lengths, got {S} vs {Sk}"
         )
     scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    if dropout_rate > 0.0 and dropout_key is None:
+        raise ValueError("dropout_rate > 0 requires a dropout_key")
     forced = impl is not None
     impl = _resolve_impl(impl)
+    if impl == "pallas" and dropout_rate > 0.0:
+        if forced:
+            raise ValueError(
+                "impl='pallas' has no in-kernel dropout; pass impl=None for "
+                "the jnp dropout path or apply dropout outside attention"
+            )
+        impl = "jnp"
     if impl == "pallas" and not (
         is_flash_available(S, D) and is_flash_available(Sk, D)
     ):
@@ -439,7 +464,8 @@ def flash_attention(
         if impl == "pallas":
             o = _flash3(q3, k3, v3, lens_bh, causal, scale)
         else:
-            o = _attn_jnp(q3, k3, v3, lens_bh, causal, scale)
+            o = _attn_jnp(q3, k3, v3, lens_bh, causal, scale,
+                          dropout_rate, dropout_key)
     return o.reshape(B, H, S, D)
 
 
@@ -453,6 +479,8 @@ def self_attention(
     *,
     causal: bool = False,
     kv_lens: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
     impl: Optional[str] = None,
 ) -> jax.Array:
     """Fused self-attention block: QKV projection → flash attention → output
@@ -477,7 +505,8 @@ def self_attention(
         return t.reshape(B, S, n_heads, hd).transpose(0, 2, 1, 3)
 
     ctx = flash_attention(
-        heads(q), heads(k), heads(v), causal=causal, kv_lens=kv_lens, impl=impl
+        heads(q), heads(k), heads(v), causal=causal, kv_lens=kv_lens,
+        dropout_rate=dropout_rate, dropout_key=dropout_key, impl=impl,
     )
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
     out = ctx @ w_out.astype(x.dtype)

@@ -51,6 +51,11 @@ class BertConfig:
     sequence_parallel: bool = False
     use_flash_attention: bool = True
     attention_impl: Optional[str] = None
+    # training regularization (BERT convention: one rate for embeddings,
+    # hidden states, and attention probs); active only when forward()
+    # receives a dropout_key
+    dropout_rate: float = 0.0
+    attention_dropout: float = 0.0
 
     @property
     def ff(self) -> int:
@@ -147,50 +152,73 @@ def param_specs(cfg: BertConfig) -> dict:
 
 
 
-def _attention(cfg: BertConfig, q, k, v, lens):
-    """Bidirectional attention with key-padding lengths."""
+def _attention(cfg: BertConfig, q, k, v, lens, attn_key=None):
+    """Bidirectional attention with key-padding lengths. ``attn_key``: probs
+    dropout key (None = deterministic)."""
     B, H, S, hd = q.shape
+    rate = cfg.attention_dropout if attn_key is not None else 0.0
     if cfg.use_flash_attention:
         from beforeholiday_tpu.ops import flash_attention
 
         return flash_attention(
             q, k, v, causal=False, scale=1.0 / np.sqrt(hd), kv_lens=lens,
+            dropout_rate=rate, dropout_key=attn_key,
             impl=cfg.attention_impl,
         )
     from beforeholiday_tpu.ops import scaled_masked_softmax
+    from beforeholiday_tpu.transformer.tensor_parallel.random import dropout
 
     scores = q @ k.transpose(0, 1, 3, 2)
     mask = (jnp.arange(S)[None, :] >= lens[:, None])[:, None, None, :]
     probs = scaled_masked_softmax(scores, mask, 1.0 / np.sqrt(hd)).astype(q.dtype)
+    if rate > 0.0:
+        probs = dropout(attn_key, probs, rate)
     return probs @ v
 
 
-def _block(cfg: BertConfig, x, lens, lp):
-    """Post-LN transformer block (BERT convention). x: (B, S, D)."""
+def _block(cfg: BertConfig, x, lens, lp, dkey=None):
+    """Post-LN transformer block (BERT convention). x: (B, S, D).
+    ``dkey``: per-layer PRNG key; None = deterministic."""
     from beforeholiday_tpu.ops import fused_dense
+    from beforeholiday_tpu.transformer.tensor_parallel.random import dropout
 
     B, S, D = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
+    training = dkey is not None
+
+    def drop(t, site):
+        if not training or cfg.dropout_rate == 0.0:
+            return t
+        return dropout(jax.random.fold_in(dkey, site), t, cfg.dropout_rate)
+
     qkv = fused_dense(x, lp["wqkv"].astype(x.dtype), lp["bqkv"].astype(x.dtype))
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    ctx = _attention(cfg, q, k, v, lens).transpose(0, 2, 1, 3).reshape(B, S, D)
-    attn_out = fused_dense(ctx, lp["wo"].astype(x.dtype), lp["bo"].astype(x.dtype))
+    attn_key = (jax.random.fold_in(dkey, 0)
+                if training and cfg.attention_dropout > 0.0 else None)
+    ctx = _attention(cfg, q, k, v, lens, attn_key).transpose(0, 2, 1, 3).reshape(B, S, D)
+    attn_out = drop(
+        fused_dense(ctx, lp["wo"].astype(x.dtype), lp["bo"].astype(x.dtype)), 1
+    )
     x = _layernorm(x + attn_out, lp["ln1_scale"], lp["ln1_bias"]).astype(x.dtype)
     x = _constrain(x, _residual_spec(cfg))
 
     h = jax.nn.gelu(fused_dense(x, lp["wi"].astype(x.dtype), lp["bi"].astype(x.dtype)))
-    mlp_out = fused_dense(h, lp["wo2"].astype(x.dtype), lp["bo2"].astype(x.dtype))
+    mlp_out = drop(
+        fused_dense(h, lp["wo2"].astype(x.dtype), lp["bo2"].astype(x.dtype)), 2
+    )
     x = _layernorm(x + mlp_out, lp["ln2_scale"], lp["ln2_bias"]).astype(x.dtype)
     return _constrain(x, _residual_spec(cfg))
 
 
 def forward(params: dict, tokens: jax.Array, cfg: BertConfig,
             token_types: Optional[jax.Array] = None,
-            seq_lens: Optional[jax.Array] = None):
-    """tokens (B, S) int32 → (mlm_logits (B, S, V), nsp_logits (B, 2))."""
+            seq_lens: Optional[jax.Array] = None,
+            dropout_key: Optional[jax.Array] = None):
+    """tokens (B, S) int32 → (mlm_logits (B, S, V), nsp_logits (B, 2)).
+    ``dropout_key`` switches the cfg dropout sites on (None = eval)."""
     B, S = tokens.shape
     lens = seq_lens if seq_lens is not None else jnp.full((B,), S, jnp.int32)
     x = params["tok_embed"][tokens] + params["pos_embed"][:S]
@@ -200,12 +228,25 @@ def forward(params: dict, tokens: jax.Array, cfg: BertConfig,
         x = x + params["type_embed"][0]
     x = _layernorm(x, params["embed_ln_scale"], params["embed_ln_bias"])
     x = x.astype(cfg.dtype)
+    if dropout_key is not None and cfg.dropout_rate > 0.0:
+        from beforeholiday_tpu.transformer.tensor_parallel.random import dropout
+
+        x = dropout(jax.random.fold_in(dropout_key, 0x7FFFFFFF), x, cfg.dropout_rate)
     x = _constrain(x, _residual_spec(cfg))
 
-    def body(carry, lp):
-        return _block(cfg, carry, lens, lp), None
+    if dropout_key is not None:
+        layer_keys = jax.random.split(dropout_key, cfg.n_layers)
 
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+        def body(carry, xs):
+            lp, lk = xs
+            return _block(cfg, carry, lens, lp, dkey=lk), None
+
+        x, _ = jax.lax.scan(body, x, (params["blocks"], layer_keys))
+    else:
+        def body(carry, lp):
+            return _block(cfg, carry, lens, lp), None
+
+        x, _ = jax.lax.scan(body, x, params["blocks"])
 
     # MLM head: dense+gelu+LN then tied decode (standalone_bert lm head)
     h = jax.nn.gelu(x @ params["mlm_dense"].astype(x.dtype) + params["mlm_bias"].astype(x.dtype))

@@ -50,6 +50,11 @@ class GPTConfig:
     # pallas/jnp dispatch for tests (None = resolve_impl policy)
     use_flash_attention: bool = True
     attention_impl: Optional[str] = None
+    # training regularization (ref: standalone GPT's hidden/attention dropout;
+    # apex/transformer/testing/standalone_transformer_lm.py) — active only
+    # when forward() receives a dropout_key
+    dropout_rate: float = 0.0          # embedding + post-attn + post-MLP
+    attention_dropout: float = 0.0     # softmax-probs dropout (jnp attn path)
 
     @property
     def ff(self) -> int:
@@ -125,12 +130,20 @@ def param_specs(cfg: GPTConfig) -> dict:
 
 
 
-def _block(cfg: GPTConfig, x, lp):
-    """One transformer block over the fused-ops layer. x: (B, S, D)."""
+def _block(cfg: GPTConfig, x, lp, dkey=None):
+    """One transformer block over the fused-ops layer. x: (B, S, D).
+    ``dkey``: per-layer PRNG key; None = deterministic (eval/bench)."""
     from beforeholiday_tpu.ops import fused_dense, scaled_upper_triang_masked_softmax
+    from beforeholiday_tpu.transformer.tensor_parallel.random import dropout
 
     B, S, D = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
+    training = dkey is not None
+
+    def drop(t, site, rate):
+        if not training or rate == 0.0:
+            return t
+        return dropout(jax.random.fold_in(dkey, site), t, rate)
 
     h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
     qkv = fused_dense(h, lp["wqkv"].astype(h.dtype), lp["bqkv"].astype(h.dtype))
@@ -138,12 +151,16 @@ def _block(cfg: GPTConfig, x, lp):
     q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    attn_rate = cfg.attention_dropout if training else 0.0
+    attn_key = jax.random.fold_in(dkey, 0) if (training and attn_rate > 0) else None
     if cfg.use_flash_attention:
         # Pallas flash attention — no (B*H, S, S) score tensor in HBM
         from beforeholiday_tpu.ops import flash_attention
 
         ctx = flash_attention(
-            q, k, v, causal=True, scale=1.0 / np.sqrt(hd), impl=cfg.attention_impl
+            q, k, v, causal=True, scale=1.0 / np.sqrt(hd),
+            dropout_rate=attn_rate, dropout_key=attn_key,
+            impl=cfg.attention_impl,
         )
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
     else:
@@ -151,27 +168,46 @@ def _block(cfg: GPTConfig, x, lp):
         probs = scaled_upper_triang_masked_softmax(
             scores, 1.0 / np.sqrt(hd)
         ).astype(x.dtype).reshape(B, H, S, S)
+        if attn_rate > 0.0:
+            probs = dropout(attn_key, probs, attn_rate)
         ctx = (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, D)
-    x = x + fused_dense(ctx, lp["wo"].astype(x.dtype), lp["bo"].astype(x.dtype))
+    attn_out = fused_dense(ctx, lp["wo"].astype(x.dtype), lp["bo"].astype(x.dtype))
+    x = x + drop(attn_out, 1, cfg.dropout_rate)
     x = _constrain(x, _residual_spec(cfg))
 
     h = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
     h = jax.nn.gelu(fused_dense(h, lp["wi"].astype(h.dtype), lp["bi"].astype(h.dtype)))
-    x = x + fused_dense(h, lp["wo2"].astype(x.dtype), lp["bo2"].astype(x.dtype))
+    mlp_out = fused_dense(h, lp["wo2"].astype(x.dtype), lp["bo2"].astype(x.dtype))
+    x = x + drop(mlp_out, 2, cfg.dropout_rate)
     return _constrain(x, _residual_spec(cfg))
 
 
-def forward(params: dict, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
-    """tokens (B, S) int32 → logits (B, S, V)."""
+def forward(params: dict, tokens: jax.Array, cfg: GPTConfig,
+            dropout_key: Optional[jax.Array] = None) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, V). ``dropout_key`` switches the
+    cfg.dropout_rate/attention_dropout sites on (None = eval: identity)."""
+    from beforeholiday_tpu.transformer.tensor_parallel.random import dropout
+
     B, S = tokens.shape
     x = params["tok_embed"][tokens] + params["pos_embed"][:S]
     x = x.astype(cfg.dtype)
+    if dropout_key is not None and cfg.dropout_rate > 0.0:
+        x = dropout(jax.random.fold_in(dropout_key, 0x7FFFFFFF), x, cfg.dropout_rate)
     x = _constrain(x, _residual_spec(cfg))
 
-    def body(carry, lp):
-        return _block(cfg, carry, lp), None
+    if dropout_key is not None:
+        layer_keys = jax.random.split(dropout_key, cfg.n_layers)
 
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+        def body(carry, xs):
+            lp, lk = xs
+            return _block(cfg, carry, lp, dkey=lk), None
+
+        x, _ = jax.lax.scan(body, x, (params["blocks"], layer_keys))
+    else:
+        def body(carry, lp):
+            return _block(cfg, carry, lp), None
+
+        x, _ = jax.lax.scan(body, x, params["blocks"])
     x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
     logits = x.astype(jnp.float32) @ params["tok_embed"].T
     return _constrain(logits, P(DATA_AXIS, None, TENSOR_AXIS))

@@ -374,6 +374,278 @@ def forward_backward_pipelining_without_interleaving(
     return loss, PipelineGrads(g_stage, g_embed, g_head)
 
 
+class EncDecPipelineGrads(NamedTuple):
+    """Gradients from an encoder-decoder pipelined run."""
+
+    stage: Any
+    enc_embed: Any
+    dec_embed: Any
+    head: Any
+
+
+def forward_backward_pipelining_encoder_decoder(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params: Any,
+    enc_inputs: jax.Array,
+    dec_inputs: jax.Array,
+    targets: jax.Array,
+    *,
+    split_rank: Optional[int] = None,
+    axis_name: str = PIPE_AXIS,
+    enc_embed_fn: Optional[Callable] = None,
+    enc_embed_params: Any = None,
+    dec_embed_fn: Optional[Callable] = None,
+    dec_embed_params: Any = None,
+    head_fn: Optional[Callable] = None,
+    head_params: Any = None,
+):
+    """T5-style encoder-and-decoder 1F1B schedule
+    (ref: apex/transformer/pipeline_parallel/schedules/common.py:83,312 —
+    ``ModelType.encoder_and_decoder`` — and parallel_state.py:502-560's
+    split-rank groups).
+
+    Ranks ``[0, split_rank)`` are encoder stages, ``[split_rank, S)`` decoder
+    stages. The TPU-native formulation keeps the single collective tick loop
+    but the rings carry a PAIR ``(hidden, memory)`` stacked as
+    ``(2, *hidden)`` — the reference's dual-tensor-shape p2p for enc-dec
+    pipelines. The encoder's final hidden becomes ``memory`` at the split
+    boundary and rides along every decoder stage for cross-attention; its
+    gradient accumulates automatically because each decoder stage's VJP pulls
+    the pair cotangent through both the pass-through and the cross-attention
+    use.
+
+    ``stage_fn(sp, h, memory, is_decoder) -> h`` — ``is_decoder`` is a traced
+    0/1 scalar (encoder stages see memory = zeros). ``dec_embed_fn`` maps
+    ``dec_inputs[m]`` to the decoder's first hidden. Encoder and decoder
+    hiddens share one shape/dtype (the reference's fixed tensor-shape
+    contract). ``split_rank`` defaults to
+    ``parallel_state.get_pipeline_model_parallel_split_rank()``.
+
+    This is a deliberate second V=1 engine sharing ``_pipelined_fwd_bwd``'s
+    tick formalism (same slot equations, ring depth, cond-gating and
+    branch-divergence rules — keep the two in sync when touching either)
+    rather than a carrier-generic refactor: threading the pair carrier and
+    boundary hooks through the interleaved V>1 path would complicate every
+    line of it for one mode the reference itself special-cases.
+
+    Returns ``(mean loss, EncDecPipelineGrads)``.
+    """
+    if split_rank is None:
+        from beforeholiday_tpu.parallel.parallel_state import (
+            get_pipeline_model_parallel_split_rank,
+        )
+
+        try:
+            split_rank = get_pipeline_model_parallel_split_rank()
+        except RuntimeError:  # parallel state not initialized
+            split_rank = None
+    if split_rank is None:
+        raise ValueError(
+            "encoder-decoder schedule needs split_rank (or an initialized "
+            "pipeline_model_parallel_split_rank)"
+        )
+
+    S = jax.lax.axis_size(axis_name)
+    if not 0 < split_rank < S:
+        # split_rank 0 (no encoder) or >= S (no decoder) would run a
+        # plausible-looking but wrong schedule: the boundary injection never
+        # fires and dec_inputs are silently ignored
+        raise ValueError(
+            f"split_rank must satisfy 0 < split_rank < pipeline size "
+            f"({S}), got {split_rank}"
+        )
+    rank = jax.lax.axis_index(axis_name)
+    M = enc_inputs.shape[0]
+    total_ticks = M + 2 * S - 1
+    ring_depth = 2 * S
+
+    is_first_dev = rank == 0
+    is_last_dev = rank == S - 1
+    is_boundary = rank == split_rank
+    is_decoder = (rank >= split_rank).astype(jnp.float32)
+
+    def run_enc_embed(ep, raw):
+        return enc_embed_fn(ep, raw) if enc_embed_fn is not None else raw
+
+    def run_dec_embed(dp, raw):
+        return dec_embed_fn(dp, raw) if dec_embed_fn is not None else raw
+
+    def run_head(hp, h):
+        return head_fn(hp, h) if head_fn is not None else h
+
+    hidden_aval = jax.eval_shape(run_enc_embed, enc_embed_params, enc_inputs[0])
+    hidden_shape, hidden_dtype = hidden_aval.shape, hidden_aval.dtype
+    pair_shape = (2,) + hidden_shape
+
+    def stage_pair(sp, pair):
+        """(h, memory) -> (stage(h), memory): memory passes through decoder
+        stages untouched (its grads still flow via the cross-attention use)."""
+        h = stage_fn(sp, pair[0], pair[1], is_decoder)
+        return jnp.stack([h.astype(hidden_dtype), pair[1]])
+
+    def make_x_in(m, fwd_pair):
+        """The pair actually fed to this rank's stage at microbatch m."""
+
+        def first():
+            z = jnp.zeros(hidden_shape, hidden_dtype)
+            return jnp.stack(
+                [run_enc_embed(enc_embed_params, enc_inputs[m]).astype(hidden_dtype), z]
+            )
+
+        def boundary():
+            # encoder output arrives in the hidden slot; it becomes memory,
+            # and the decoder stream starts from its own embedding
+            return jnp.stack([
+                run_dec_embed(dec_embed_params, dec_inputs[m]).astype(hidden_dtype),
+                fwd_pair[0],
+            ])
+
+        return jax.lax.cond(
+            is_first_dev, first,
+            lambda: jax.lax.cond(is_boundary, boundary, lambda: fwd_pair),
+        )
+
+    zeros_stage_g = jax.tree.map(jnp.zeros_like, params)
+    zeros_ee_g = (jax.tree.map(jnp.zeros_like, enc_embed_params)
+                  if enc_embed_fn is not None else None)
+    zeros_de_g = (jax.tree.map(jnp.zeros_like, dec_embed_params)
+                  if dec_embed_fn is not None else None)
+    zeros_head_g = (jax.tree.map(jnp.zeros_like, head_params)
+                    if head_fn is not None else None)
+
+    def tick(t, carry):
+        (act_store, fwd_reg, bwd_reg, g_stage, g_ee, g_de, g_head,
+         loss_acc) = carry
+
+        # ---- forward slot ---------------------------------------------------------
+        with jax.named_scope("ppT5_forward_slot"):
+            u = t - rank
+            f_valid = (u >= 0) & (u < M)
+            m_f = jnp.clip(u, 0, M - 1)
+
+            def fwd_compute():
+                x_in = make_x_in(m_f, fwd_reg)
+                return x_in, stage_pair(params, x_in)
+
+            def fwd_idle():
+                z = jnp.zeros(pair_shape, hidden_dtype)
+                return z, z
+
+            x_in, y = jax.lax.cond(f_valid, fwd_compute, fwd_idle)
+            slot_f = (m_f + rank) % ring_depth
+            act_store = jnp.where(
+                f_valid,
+                jax.lax.dynamic_update_index_in_dim(act_store, x_in, slot_f, 0),
+                act_store,
+            )
+
+        # ---- backward slot --------------------------------------------------------
+        ub = t - S - (S - 1 - rank)
+        b_valid = (ub >= 0) & (ub < M)
+        m_b = jnp.clip(ub, 0, M - 1)
+        slot_b = (m_b + rank) % ring_depth
+        x_saved = jax.lax.dynamic_index_in_dim(act_store, slot_b, 0, keepdims=False)
+        tgt_b = targets[m_b]
+
+        def last_branch():
+            def full(sp, hp, pair):
+                out = run_head(hp, stage_pair(sp, pair)[0])
+                return loss_fn(out, tgt_b) / M
+
+            if head_fn is not None:
+                mb_loss, (dsp, dhp, dx) = jax.value_and_grad(full, argnums=(0, 1, 2))(
+                    params, head_params, x_saved
+                )
+                return mb_loss.astype(jnp.float32), dsp, dhp, dx
+            mb_loss, (dsp, dx) = jax.value_and_grad(
+                lambda sp, pair: full(sp, None, pair), argnums=(0, 1)
+            )(params, x_saved)
+            return mb_loss.astype(jnp.float32), dsp, zeros_head_g, dx
+
+        def inner_branch():
+            _, vjp = jax.vjp(stage_pair, params, x_saved)
+            dsp, dx = vjp(bwd_reg.astype(hidden_dtype))
+            return jnp.float32(0.0), dsp, zeros_head_g, dx
+
+        def idle_branch():
+            return (jnp.float32(0.0), zeros_stage_g, zeros_head_g,
+                    jnp.zeros(pair_shape, hidden_dtype))
+
+        with jax.named_scope("ppT5_backward_slot"):
+            mb_loss, dsp, dhp, dx = jax.lax.cond(
+                b_valid,
+                lambda: jax.lax.cond(is_last_dev, last_branch, inner_branch),
+                idle_branch,
+            )
+
+        loss_acc = loss_acc + jnp.where(b_valid & is_last_dev, mb_loss, 0.0)
+        g_stage = _acc_tree(g_stage, b_valid, dsp)
+        if head_fn is not None:
+            g_head = _acc_tree(g_head, b_valid & is_last_dev, dhp)
+
+        # embedding VJPs + the boundary cotangent remap: the saved x_in is
+        # POST make_x_in, so dx[0] belongs to this rank's own embedding at
+        # the first/boundary ranks, and the cotangent sent upstream from the
+        # boundary is (d memory, 0) — the encoder output's gradient
+        if enc_embed_fn is not None:
+            def enc_grad():
+                _, vjp_e = jax.vjp(
+                    lambda ep: run_enc_embed(ep, enc_inputs[m_b]), enc_embed_params
+                )
+                (dep,) = vjp_e(dx[0].astype(hidden_dtype))
+                return dep
+
+            dep = jax.lax.cond(
+                b_valid & is_first_dev, enc_grad, lambda: zeros_ee_g
+            )
+            g_ee = _acc_tree(g_ee, b_valid & is_first_dev, dep)
+        if dec_embed_fn is not None:
+            def dec_grad():
+                _, vjp_d = jax.vjp(
+                    lambda dp: run_dec_embed(dp, dec_inputs[m_b]), dec_embed_params
+                )
+                (ddp,) = vjp_d(dx[0].astype(hidden_dtype))
+                return ddp
+
+            ddp = jax.lax.cond(
+                b_valid & is_boundary, dec_grad, lambda: zeros_de_g
+            )
+            g_de = _acc_tree(g_de, b_valid & is_boundary, ddp)
+
+        dx_ring = jnp.where(
+            is_boundary,
+            jnp.stack([dx[1], jnp.zeros(hidden_shape, hidden_dtype)]),
+            dx,
+        )
+
+        # ---- rings ---------------------------------------------------------------
+        with jax.named_scope("ppT5_p2p_rings"):
+            fwd_reg, bwd_reg = p2p_communication.send_forward_recv_backward(
+                jnp.where(f_valid, y, 0.0).astype(hidden_dtype),
+                jnp.where(b_valid, dx_ring, 0.0).astype(hidden_dtype),
+                axis_name=axis_name,
+            )
+        return (act_store, fwd_reg, bwd_reg, g_stage, g_ee, g_de, g_head, loss_acc)
+
+    act_store0 = jnp.zeros((ring_depth,) + pair_shape, hidden_dtype)
+    fwd_reg0 = jnp.zeros(pair_shape, hidden_dtype)
+    bwd_reg0 = jnp.zeros(pair_shape, hidden_dtype)
+    (_, _, _, g_stage, g_ee, g_de, g_head, loss) = jax.lax.fori_loop(
+        0, total_ticks, tick,
+        (act_store0, fwd_reg0, bwd_reg0, zeros_stage_g, zeros_ee_g, zeros_de_g,
+         zeros_head_g, jnp.float32(0.0)),
+    )
+    loss = jax.lax.psum(loss, axis_name)
+    if enc_embed_fn is not None:
+        g_ee = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_ee)
+    if dec_embed_fn is not None:
+        g_de = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_de)
+    if head_fn is not None:
+        g_head = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_head)
+    return loss, EncDecPipelineGrads(g_stage, g_ee, g_de, g_head)
+
+
 def forward_backward_pipelining_with_interleaving(
     stage_fn: Callable,
     loss_fn: Callable,

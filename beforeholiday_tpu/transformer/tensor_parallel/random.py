@@ -25,6 +25,7 @@ import functools
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 
 from beforeholiday_tpu.parallel.parallel_state import (
     DATA_AXIS,
@@ -45,6 +46,38 @@ def model_parallel_seed(key: jax.Array, axis_name: str = TENSOR_AXIS) -> jax.Arr
 def data_parallel_seed(key: jax.Array, axis_name: str = DATA_AXIS) -> jax.Array:
     """Per-DP-rank key (e.g. independent data augmentation per replica)."""
     return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+def dropout(
+    key: jax.Array,
+    x: jax.Array,
+    rate: float,
+    *,
+    tp_distinct: bool = False,
+    axis_name: str = TENSOR_AXIS,
+    deterministic: bool = False,
+) -> jax.Array:
+    """Inverted dropout drawing from the tracker's key discipline.
+
+    The consumer the reference's ``CudaRNGStatesTracker`` exists for
+    (ref: apex/transformer/tensor_parallel/random.py:124-199): dropout inside
+    TP regions must draw DISTINCT masks per TP rank (``tp_distinct=True``
+    folds in the rank via :func:`model_parallel_seed` — only valid inside
+    shard_map with the axis bound) yet IDENTICAL masks when a checkpointed
+    region replays in the backward — automatic here, since a replayed trace
+    re-folds the same key.
+
+    ``rate`` is static; masks scale survivors by 1/(1-rate) like
+    torch.nn.functional.dropout. ``deterministic=True`` (eval) is identity.
+    """
+    if deterministic or rate == 0.0:
+        return x
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"dropout rate must be in [0, 1), got {rate}")
+    if tp_distinct:
+        key = model_parallel_seed(key, axis_name)
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros((), x.dtype)).astype(x.dtype)
 
 
 def checkpoint(

@@ -462,3 +462,133 @@ class TestP2P:
         y, dy = jax.jit(f)(jnp.arange(4.0), jnp.arange(4.0) * 10)
         np.testing.assert_allclose(np.asarray(y), [3, 0, 1, 2])
         np.testing.assert_allclose(np.asarray(dy), [10, 20, 30, 0])
+
+
+# --- encoder-decoder (T5-style) schedule: loss/grad identity oracle -------------
+# (ref: ModelType.encoder_and_decoder, schedules/common.py:83,312)
+
+
+def t5_stage_fn(sp, h, mem, is_decoder):
+    """Toy enc/dec stage: shared trunk + a cross-attention-ish term gated by
+    is_decoder (a traced 0/1 scalar, differentiable where used)."""
+    base = jax.nn.gelu(h @ sp["w"] + sp["b"]) + h
+    cross = jnp.tanh(mem @ sp["wm"])
+    return base + is_decoder * cross
+
+
+def t5_init_stages(key, n_stages):
+    ks = jax.random.split(key, 2)
+    return {
+        "w": jnp.stack([jax.random.normal(k, (HIDDEN, HIDDEN)) * 0.3
+                        for k in jax.random.split(ks[0], n_stages)]),
+        "b": jnp.zeros((n_stages, HIDDEN)),
+        "wm": jnp.stack([jax.random.normal(k, (HIDDEN, HIDDEN)) * 0.3
+                         for k in jax.random.split(ks[1], n_stages)]),
+    }
+
+
+def t5_embed(ep, raw):
+    return raw @ ep["we"]
+
+
+def t5_head(hp, h):
+    return h @ hp["wh"]
+
+
+def t5_sequential_reference(stacked, ee, de, hp, enc_in, dec_in, targets, split):
+    """Ground truth: encoder stages then decoder stages, one device."""
+    M = enc_in.shape[0]
+
+    def one(stacked, ee, de, hp, e_x, d_x, tgt):
+        h = t5_embed(ee, e_x)
+        for s in range(split):
+            sp = jax.tree.map(lambda v: v[s], stacked)
+            h = t5_stage_fn(sp, h, jnp.zeros_like(h), 0.0)
+        mem = h
+        h = t5_embed(de, d_x)
+        for s in range(split, stacked["w"].shape[0]):
+            sp = jax.tree.map(lambda v: v[s], stacked)
+            h = t5_stage_fn(sp, h, mem, 1.0)
+        return loss_fn(t5_head(hp, h), tgt)
+
+    def total(stacked, ee, de, hp):
+        losses = jax.vmap(
+            lambda e, d, t: one(stacked, ee, de, hp, e, d, t)
+        )(enc_in, dec_in, targets)
+        return jnp.mean(losses)
+
+    return jax.value_and_grad(total, argnums=(0, 1, 2, 3))(stacked, ee, de, hp)
+
+
+class TestEncoderDecoderSchedule:
+    @pytest.mark.parametrize("split", [1, 2, 3])
+    def test_t5_1f1b_matches_sequential(self, devices8, split):
+        S = 4
+        M = 6
+        rng = np.random.RandomState(0)
+        stacked = t5_init_stages(jax.random.PRNGKey(1), S)
+        ee = {"we": jnp.asarray(rng.randn(HIDDEN, HIDDEN) * 0.3, jnp.float32)}
+        de = {"we": jnp.asarray(rng.randn(HIDDEN, HIDDEN) * 0.3, jnp.float32)}
+        hp = {"wh": jnp.asarray(rng.randn(HIDDEN, HIDDEN) * 0.3, jnp.float32)}
+        enc_in = jnp.asarray(rng.randn(M, MICRO, HIDDEN), jnp.float32)
+        dec_in = jnp.asarray(rng.randn(M, MICRO, HIDDEN), jnp.float32)
+        targets = jnp.asarray(rng.randn(M, MICRO, HIDDEN), jnp.float32)
+
+        ref_loss, (ref_gs, ref_gee, ref_gde, ref_ghp) = t5_sequential_reference(
+            stacked, ee, de, hp, enc_in, dec_in, targets, split
+        )
+
+        mesh = Mesh(np.asarray(devices8[:S]), ("pipe",))
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P("pipe"), P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), (P("pipe"), P(), P(), P())),
+        )
+        def run(stacked_local, ee, de, hp, enc_in, dec_in, targets):
+            sp = jax.tree.map(lambda v: v[0], stacked_local)
+            loss, grads = pp.forward_backward_pipelining_encoder_decoder(
+                t5_stage_fn, loss_fn, sp, enc_in, dec_in, targets,
+                split_rank=split,
+                enc_embed_fn=t5_embed, enc_embed_params=ee,
+                dec_embed_fn=t5_embed, dec_embed_params=de,
+                head_fn=t5_head, head_params=hp,
+            )
+            return loss, (
+                jax.tree.map(lambda g: g[None], grads.stage),
+                grads.enc_embed, grads.dec_embed, grads.head,
+            )
+
+        loss, (gs, gee, gde, ghp) = run(
+            stacked, ee, de, hp, enc_in, dec_in, targets
+        )
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for k in ("w", "b", "wm"):
+            np.testing.assert_allclose(
+                np.asarray(gs[k]), np.asarray(ref_gs[k]), rtol=1e-4, atol=1e-5
+            )
+        np.testing.assert_allclose(
+            np.asarray(gee["we"]), np.asarray(ref_gee["we"]), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(gde["we"]), np.asarray(ref_gde["we"]), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ghp["wh"]), np.asarray(ref_ghp["wh"]), rtol=1e-4, atol=1e-5
+        )
+
+    def test_requires_split_rank(self, devices8):
+        mesh = Mesh(np.asarray(devices8[:2]), ("pipe",))
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+        )
+        def run(x):
+            loss, _ = pp.forward_backward_pipelining_encoder_decoder(
+                t5_stage_fn, loss_fn, {}, x, x, x,
+            )
+            return loss
+
+        with pytest.raises(ValueError, match="split_rank"):
+            run(jnp.zeros((2, MICRO, HIDDEN)))
