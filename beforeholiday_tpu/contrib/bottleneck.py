@@ -115,20 +115,44 @@ def spatial_bottleneck(
 ) -> jax.Array:
     """Bottleneck on an H-sharded activation (ref: SpatialBottleneck,
     bottleneck.py:380-603): the 3x3 conv sees one halo row from each
-    neighbor via the ppermute exchange, everything else is rank-local."""
-    if stride != 1:
-        raise NotImplementedError(
-            "spatial_bottleneck supports stride 1 (strided 3x3 would need "
-            "per-rank phase alignment of the halo rows)"
+    neighbor via the ppermute exchange, everything else is rank-local.
+
+    stride 2 (every ResNet stage boundary) handles the reference's strided
+    spatial path (:380-603). Phase alignment: XLA's SAME padding for k=3/s=2
+    on even H is (top 0, bottom 1), putting output centers at odd global
+    rows — so with an even per-rank H each rank emits H_local/2 rows whose
+    windows start at its own first row: the 3x3 needs only the BOTTOM halo
+    (the exchanged top halo row is dropped), and the strided 1x1s
+    (downsample path) are phase-aligned rank-locally with zero padding.
+    """
+    if stride not in (1, 2):
+        raise NotImplementedError(f"spatial_bottleneck stride must be 1 or 2, got {stride}")
+    if stride == 2 and x.shape[1] % 2 != 0:
+        raise ValueError(
+            f"stride-2 spatial bottleneck needs an even per-rank H for a "
+            f"uniform output phase across ranks, got {x.shape[1]}"
         )
     h = jax.nn.relu(_conv(x, p.w1).astype(jnp.float32) * p.s1 + p.b1).astype(x.dtype)
     h = halo_exchange_1d(h, 1, axis_name=axis_name, dim=1)
-    # halo rows replace SAME zero-padding at the shard seams: convolve with
-    # no padding on H (the exchange provided it), SAME (1,1) on W
-    h = jax.lax.conv_general_dilated(
-        h, p.w2.astype(h.dtype), (1, 1), [(0, 0), (1, 1)],
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
+    if stride == 1:
+        # halo rows replace SAME zero-padding at the shard seams: convolve
+        # with no padding on H (the exchange provided it), SAME (1,1) on W
+        h = jax.lax.conv_general_dilated(
+            h, p.w2.astype(h.dtype), (1, 1), [(0, 0), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+    else:
+        # windows start at padded-local row 1 (= this rank's first row): drop
+        # the top halo, stride 2 with no H padding; W (unsharded) keeps
+        # XLA SAME semantics: pad_total = max((ceil(W/2)-1)*2 + 3 - W, 0),
+        # split low-first — (0,1) for even W, (1,1) for odd
+        W = h.shape[2]
+        wt = max((-(-W // 2) - 1) * 2 + 3 - W, 0)
+        h = jax.lax.slice_in_dim(h, 1, h.shape[1], axis=1)
+        h = jax.lax.conv_general_dilated(
+            h, p.w2.astype(h.dtype), (2, 2), [(0, 0), (wt // 2, wt - wt // 2)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
     h = jax.nn.relu(h.astype(jnp.float32) * p.s2 + p.b2)
     h = _conv(h.astype(x.dtype), p.w3).astype(jnp.float32) * p.s3 + p.b3
     if p.w_down is not None:

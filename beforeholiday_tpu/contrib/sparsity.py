@@ -18,11 +18,11 @@ Functional TPU port:
   optimizer so every step re-applies the masks (the patched-``step``
   semantics, ref: asp.py:188-202, as an explicit wrapper).
 
-Waived: the offline channel-permutation search (permutation_lib.py, 925 LoC
-host-side preprocessing that reorders channels before masking to preserve
-accuracy). It is an optional quality heuristic with no device-side
-component; the core sparsity contract (masks, training-time enforcement,
-checkpoint-stable masks) is complete without it.
+* ``permutation_search`` — the offline channel-permutation search
+  (ref: permutation_lib.py, the accuracy-preserving half of ASP): find an
+  input-channel permutation that maximizes the magnitude the n:m mask
+  retains, via vectorized greedy column swaps (exhaustive group assignment
+  for tiny widths). Host-side numpy, like the reference's preprocessing.
 """
 
 from __future__ import annotations
@@ -181,3 +181,115 @@ class ASP:
                 return getattr(self._inner, name)
 
         return _MaskedOptimizer(optimizer)
+
+
+# ---------------------------------------------------------------------------------
+# channel-permutation search (ref: apex/contrib/sparsity/permutation_lib.py —
+# the offline preprocessing that reorders INPUT channels so the n:m magnitude
+# mask keeps more weight; host-side numpy, as in the reference)
+# ---------------------------------------------------------------------------------
+
+
+def retained_magnitude(w, perm=None, m: int = 4, n: int = 2) -> float:
+    """Sum of |w| kept by the n:m (per-row, per-m-group) mask after permuting
+    input channels by ``perm``. w: (out, in); ``in`` divisible by m."""
+    a = np.abs(np.asarray(w, np.float64))
+    if perm is not None:
+        a = a[:, np.asarray(perm)]
+    R, C = a.shape
+    if C % m:
+        raise ValueError(f"in-dim {C} not divisible by group size {m}")
+    g = a.reshape(R, C // m, m)
+    # top-n per (row, group): sort ascending, take the last n
+    return float(np.sort(g, axis=-1)[..., m - n:].sum())
+
+
+def _group_scores(a_groups, n):
+    """(R, G, m) |w| -> (G,) retained magnitude per group."""
+    m = a_groups.shape[-1]
+    return np.sort(a_groups, axis=-1)[..., m - n:].sum(axis=(0, 2))
+
+
+def permutation_search(
+    w,
+    m: int = 4,
+    n: int = 2,
+    *,
+    max_swaps: int = 10_000,
+    exhaustive_below: int = 9,
+):
+    """Search an input-channel permutation maximizing n:m retained magnitude
+    (ref: permutation_lib.py's greedy channel-swap search; a TWO-group width
+    is additionally solved exactly — picking one group's member set is the
+    whole partition there).
+
+    Greedy: repeatedly evaluate ALL single column swaps between different
+    groups (vectorized over group pairs) and apply the best until no swap
+    improves. Only-improving moves mean the result NEVER retains less than
+    the identity permutation. Returns (perm, retained, retained_identity).
+    """
+    a0 = np.abs(np.asarray(w, np.float64))
+    R, C = a0.shape
+    if C % m:
+        raise ValueError(f"in-dim {C} not divisible by group size {m}")
+    G = C // m
+    base = retained_magnitude(a0, None, m, n)
+    if G == 1:
+        return np.arange(C), base, base
+
+    if G == 2 and C <= exhaustive_below:
+        # exactly two groups: enumerating group 0's member set IS the full
+        # partition space (G >= 3 would need set-partition enumeration — the
+        # greedy below handles those)
+        best_perm, best_val = np.arange(C), base
+        for combo in itertools.combinations(range(C), m):
+            rest = [c for c in range(C) if c not in combo]
+            perm = np.array(list(combo) + rest)
+            val = retained_magnitude(a0, perm, m, n)
+            if val > best_val:
+                best_perm, best_val = perm, val
+        return best_perm, best_val, base
+
+    perm = np.arange(C)
+    a = a0.copy()
+    swaps = 0
+    while swaps < max_swaps:
+        groups = a.reshape(R, G, m)
+        scores = _group_scores(groups, n)  # (G,)
+        # evaluate every cross-group single swap: for group pair (i, j) and
+        # positions (p, q), new score of the pair with columns exchanged
+        best_gain, best_move = 1e-12, None
+        for i in range(G - 1):
+            gi = groups[:, i, :]  # (R, m)
+            for j in range(i + 1, G):
+                gj = groups[:, j, :]
+                # build all m*m swapped variants at once: (m, m, R, m)
+                gi_var = np.broadcast_to(gi, (m, m, R, m)).copy()
+                gj_var = np.broadcast_to(gj, (m, m, R, m)).copy()
+                for p in range(m):
+                    for q in range(m):
+                        gi_var[p, q, :, p] = gj[:, q]
+                        gj_var[p, q, :, q] = gi[:, p]
+                si = np.sort(gi_var, axis=-1)[..., m - n:].sum(axis=(2, 3))
+                sj = np.sort(gj_var, axis=-1)[..., m - n:].sum(axis=(2, 3))
+                gain = si + sj - (scores[i] + scores[j])  # (m, m)
+                p, q = np.unravel_index(np.argmax(gain), gain.shape)
+                if gain[p, q] > best_gain:
+                    best_gain = float(gain[p, q])
+                    best_move = (i, j, int(p), int(q))
+        if best_move is None:
+            break
+        i, j, p, q = best_move
+        ci, cj = i * m + p, j * m + q
+        perm[[ci, cj]] = perm[[cj, ci]]
+        a[:, [ci, cj]] = a[:, [cj, ci]]
+        swaps += 1
+    return perm, retained_magnitude(a0, perm, m, n), base
+
+
+def apply_input_permutation(w, perm):
+    """Permute a weight's input channels (columns). The producing layer's
+    OUTPUT channels (rows) must be permuted identically for the network
+    function to be preserved — the reference's graph pass applies exactly
+    this pairing; with a functional pytree the caller owns the wiring."""
+    return jnp.asarray(w)[:, jnp.asarray(np.asarray(perm))]

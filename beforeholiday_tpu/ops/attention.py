@@ -186,11 +186,14 @@ def _fa_fwd_pallas(q, k, v, lens, causal, scale, interpret):
 # ---------------------------------------------------------------------------------
 
 
-def _block_p_ds(causal, scale, i, j, lens, q, k, v, do, o, lse, bq, bk):
+def _block_p_ds(causal, scale, i, j, lens, q, k, v, do, o, lse, dlse, bq, bk):
     """Shared recompute: probabilities p and score-grad ds for block (i, j).
-    ``lse``: (BQ, 128) lane-replicated; delta_i = rowsum(dO_i * O_i) is
-    recomputed here from the o/do blocks (cheap VPU work vs another HBM
-    residual). Matmuls run in the input dtype with fp32 accumulation."""
+    ``lse``/``dlse``: (BQ, 128) lane-replicated; delta_i = rowsum(dO_i * O_i)
+    is recomputed here from the o/do blocks (cheap VPU work vs another HBM
+    residual). ``dlse`` is the cotangent of the EXPOSED lse output (zero for
+    plain attention; nonzero when the caller merges chunk outputs by lse, as
+    ring attention does — d lse_i/d s_ij = p_ij adds dlse_i inside the
+    parens). Matmuls run in the input dtype with fp32 accumulation."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -201,12 +204,18 @@ def _block_p_ds(causal, scale, i, j, lens, q, k, v, do, o, lse, bq, bk):
     )
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
-    ds = p * (dp - delta) * scale
+    extra = dlse[:, 0:1] if dlse is not None else 0.0
+    ds = p * (dp - delta + extra) * scale
     return p, ds
 
 
-def _fa_dq_kernel(causal, scale, nk, bq, bk, lens_ref, q_ref, k_ref, v_ref,
-                  do_ref, o_ref, lse_ref, dq_ref, dq_acc):
+def _fa_dq_kernel(causal, scale, nk, bq, bk, has_dlse, lens_ref, q_ref, k_ref,
+                  v_ref, do_ref, o_ref, lse_ref, *rest):
+    if has_dlse:
+        dlse_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
+        dlse_ref = None
     b, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -220,7 +229,7 @@ def _fa_dq_kernel(causal, scale, nk, bq, bk, lens_ref, q_ref, k_ref, v_ref,
         _, ds = _block_p_ds(
             causal, scale, i, j, lens_ref[b],
             q_ref[0], k_ref[0], v_ref[0], do_ref[0], o_ref[0], lse_ref[0],
-            bq, bk,
+            dlse_ref[0] if has_dlse else None, bq, bk,
         )
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0],
@@ -232,8 +241,13 @@ def _fa_dq_kernel(causal, scale, nk, bq, bk, lens_ref, q_ref, k_ref, v_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _fa_dkv_kernel(causal, scale, nq, bq, bk, lens_ref, q_ref, k_ref, v_ref,
-                   do_ref, o_ref, lse_ref, dk_ref, dv_ref, dk_acc, dv_acc):
+def _fa_dkv_kernel(causal, scale, nq, bq, bk, has_dlse, lens_ref, q_ref, k_ref,
+                   v_ref, do_ref, o_ref, lse_ref, *rest):
+    if has_dlse:
+        dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        dlse_ref = None
     # k block outer, q block inner
     b, j, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
@@ -249,7 +263,7 @@ def _fa_dkv_kernel(causal, scale, nq, bq, bk, lens_ref, q_ref, k_ref, v_ref,
         p, ds = _block_p_ds(
             causal, scale, i, j, lens_ref[b],
             q_ref[0], k_ref[0], v_ref[0], do_ref[0], o_ref[0], lse_ref[0],
-            bq, bk,
+            dlse_ref[0] if has_dlse else None, bq, bk,
         )
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0],
@@ -266,21 +280,27 @@ def _fa_dkv_kernel(causal, scale, nq, bq, bk, lens_ref, q_ref, k_ref, v_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _fa_bwd_pallas(q, k, v, do, o, lse, lens, causal, scale, interpret):
+def _fa_bwd_pallas(q, k, v, do, o, lse, dlse, lens, causal, scale, interpret):
+    """``dlse=None`` (the plain-attention path) omits the operand entirely —
+    an all-zero lane-replicated dlse would otherwise add an arena-sized HBM
+    read to BOTH backward kernels for nothing."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _block_size(Sq, D), _block_size(Sk, D)
     nq, nk = Sq // bq, Sk // bk
+    has_dlse = dlse is not None
+    dlse_ops = (dlse,) if has_dlse else ()
     lens_i = lens.astype(jnp.int32)
     qspec_i = pl.BlockSpec((1, bq, D), lambda b, i, j, lens_ref: (b, i, 0))
     kspec_j = pl.BlockSpec((1, bk, D), lambda b, i, j, lens_ref: (b, j, 0))
     lse_i = pl.BlockSpec((1, bq, 128), lambda b, i, j, lens_ref: (b, i, 0))
     dq = pl.pallas_call(
-        functools.partial(_fa_dq_kernel, causal, scale, nk, bq, bk),
+        functools.partial(_fa_dq_kernel, causal, scale, nk, bq, bk, has_dlse),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(BH, nq, nk),
-            in_specs=[qspec_i, kspec_j, kspec_j, qspec_i, qspec_i, lse_i],
+            in_specs=[qspec_i, kspec_j, kspec_j, qspec_i, qspec_i, lse_i]
+                     + ([lse_i] if has_dlse else []),
             out_specs=qspec_i,
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         ),
@@ -289,18 +309,19 @@ def _fa_bwd_pallas(q, k, v, do, o, lse, lens, causal, scale, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(lens_i, q, k, v, do, o, lse)
+    )(lens_i, q, k, v, do, o, lse, *dlse_ops)
 
     # dkv grid: (BH, k-block, q-block) — q-side operands indexed by the INNER id
     qspec_in = pl.BlockSpec((1, bq, D), lambda b, j, i, lens_ref: (b, i, 0))
     kspec_out = pl.BlockSpec((1, bk, D), lambda b, j, i, lens_ref: (b, j, 0))
     lse_in = pl.BlockSpec((1, bq, 128), lambda b, j, i, lens_ref: (b, i, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_fa_dkv_kernel, causal, scale, nq, bq, bk),
+        functools.partial(_fa_dkv_kernel, causal, scale, nq, bq, bk, has_dlse),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(BH, nk, nq),
-            in_specs=[qspec_in, kspec_out, kspec_out, qspec_in, qspec_in, lse_in],
+            in_specs=[qspec_in, kspec_out, kspec_out, qspec_in, qspec_in, lse_in]
+                     + ([lse_in] if has_dlse else []),
             out_specs=[kspec_out, kspec_out],
             scratch_shapes=[
                 pltpu.VMEM((bk, D), jnp.float32),
@@ -315,7 +336,7 @@ def _fa_bwd_pallas(q, k, v, do, o, lse, lens, causal, scale, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(lens_i, q, k, v, do, o, lse)
+    )(lens_i, q, k, v, do, o, lse, *dlse_ops)
     return dq, dk, dv
 
 
@@ -338,12 +359,51 @@ def _flash3_fwd(q, k, v, lens, causal, scale):
 def _flash3_bwd(causal, scale, res, do):
     q, k, v, lens, o, lse = res
     dq, dk, dv = _fa_bwd_pallas(
-        q, k, v, do, o, lse, lens, causal, scale, _interpret_default()
+        q, k, v, do, o, lse, None, lens, causal, scale, _interpret_default()
     )
     return dq, dk, dv, jnp.zeros_like(lens)
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+# --- (o, lse) variant for chunk-merging callers (ring attention) ----------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash3_lse(q, k, v, lens, causal, scale):
+    o, lse = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default())
+    return o, lse[..., 0]
+
+
+def _flash3_lse_fwd(q, k, v, lens, causal, scale):
+    o, lse = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default())
+    return (o, lse[..., 0]), (q, k, v, lens, o, lse)
+
+
+def _flash3_lse_bwd(causal, scale, res, cts):
+    do, dlse_row = cts
+    q, k, v, lens, o, lse = res
+    dlse = jnp.broadcast_to(dlse_row[..., None], lse.shape)
+    dq, dk, dv = _fa_bwd_pallas(
+        q, k, v, do, o, lse, dlse, lens, causal, scale, _interpret_default()
+    )
+    return dq, dk, dv, jnp.zeros_like(lens)
+
+
+_flash3_lse.defvjp(_flash3_lse_fwd, _flash3_lse_bwd)
+
+
+def flash_attention_with_lse(q3, k3, v3, *, causal, scale, kv_lens=None):
+    """(BH, S, D) flash attention returning (o, lse (BH, S)) — the merge
+    interface for blockwise/ring composition (lse = m + log l per row;
+    fully-masked rows carry lse = -1e30 so their merge weight underflows to
+    exactly zero). Differentiable in q/k/v AND through lse (the backward
+    kernels take the dlse cotangent)."""
+    BH, S, D = q3.shape
+    if kv_lens is None:
+        kv_lens = jnp.full((BH,), float(k3.shape[1]), jnp.float32)
+    return _flash3_lse(q3, k3, v3, kv_lens.astype(jnp.float32), causal, scale)
 
 
 # ---------------------------------------------------------------------------------

@@ -29,6 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.ops._pallas_util import resolve_impl as _resolve_impl
 from beforeholiday_tpu.parallel.parallel_state import CONTEXT_AXIS
 
 _NEG = -1e30
@@ -42,10 +43,20 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     axis_name: str = CONTEXT_AXIS,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Sequence-sharded attention. Runs INSIDE shard_map with ``axis_name``
     bound; q/k/v: (B, H, S_local, D), the global sequence laid out in rank
-    order along the axis. Returns (B, H, S_local, D) in q's dtype."""
+    order along the axis. Returns (B, H, S_local, D) in q's dtype.
+
+    ``impl`` follows the repo dispatch policy: on the pallas path each hop's
+    block compute is the flash kernel via ``flash_attention_with_lse`` and
+    hops merge by (o, lse) — the blockwise-composition property flash
+    attention is built on — instead of the jnp online-softmax hop. Causality
+    per hop is STATIC: hop 0 is the rank's own chunk (causal kernel); every
+    later hop is either a fully earlier chunk (unmasked) or a fully later one
+    (kv_len 0), expressed through the kernel's traced ``kv_lens``.
+    """
     if q.ndim != 4:
         raise ValueError(f"expected (B, H, S_local, D), got {q.shape}")
     B, H, Sl, D = q.shape
@@ -53,6 +64,15 @@ def ring_attention(
     cp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    from beforeholiday_tpu.ops.attention import is_flash_available
+
+    impl = _resolve_impl(impl)
+    if impl == "pallas" and is_flash_available(Sl, D):
+        return _ring_attention_flash(
+            q, k, v, causal=causal, scale=scale, axis_name=axis_name,
+            cp=cp, rank=rank, perm=perm,
+        )
 
     qf = q.astype(jnp.float32)
     q_pos = rank * Sl + jnp.arange(Sl)  # global query positions
@@ -102,3 +122,61 @@ def ring_attention(
     nonempty = l > 0.0
     out = jnp.where(nonempty, acc / jnp.where(nonempty, l, 1.0), 0.0)
     return out.astype(q.dtype)
+
+
+def _merge_by_lse(o_a, lse_a, o_b, lse_b):
+    """Combine two normalized chunk outputs by their log-sum-exps — the
+    blockwise flash-attention merge. Empty chunks carry lse = -1e30, whose
+    weight underflows to exactly zero."""
+    m = jnp.maximum(lse_a, lse_b)
+    wa = jnp.exp(lse_a - m)
+    wb = jnp.exp(lse_b - m)
+    denom = wa + wb
+    o = (o_a * wa[..., None] + o_b * wb[..., None]) / denom[..., None]
+    return o, m + jnp.log(denom)
+
+
+def _ring_attention_flash(q, k, v, *, causal, scale, axis_name, cp, rank, perm):
+    """Flash-kernel hops: each ring step runs the Pallas kernel on the
+    received chunk and merges (o, lse). The kernel's dlse-aware backward
+    makes the merge differentiable end to end."""
+    from beforeholiday_tpu.ops.attention import flash_attention_with_lse
+
+    B, H, Sl, D = q.shape
+    q3 = q.reshape(B * H, Sl, D)
+
+    def hop(k_cur, v_cur, src, hop_causal):
+        k3 = k_cur.reshape(B * H, Sl, D)
+        v3 = v_cur.reshape(B * H, Sl, D)
+        if hop_causal:
+            o, lse = flash_attention_with_lse(q3, k3, v3, causal=True, scale=scale)
+        else:
+            if causal:
+                # chunks strictly earlier than ours attend fully; strictly
+                # later ones not at all — a traced per-batch kv_len
+                lens = jnp.where(src < rank, float(Sl), 0.0)
+            else:
+                lens = jnp.float32(Sl)
+            o, lse = flash_attention_with_lse(
+                q3, k3, v3, causal=False, scale=scale,
+                kv_lens=jnp.full((B * H,), lens, jnp.float32),
+            )
+        return o.astype(jnp.float32), lse
+
+    o_acc, lse_acc = hop(k, v, rank, causal)
+
+    def body(carry, t):
+        k_cur, v_cur, o_acc, lse_acc = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (rank - t) % cp
+        o_t, lse_t = hop(k_cur, v_cur, src, False)
+        o_acc, lse_acc = _merge_by_lse(o_acc, lse_acc, o_t, lse_t)
+        return (k_cur, v_cur, o_acc, lse_acc), None
+
+    if cp > 1:
+        (_, _, o_acc, lse_acc), _ = jax.lax.scan(
+            body, (k, v, o_acc, lse_acc), jnp.arange(1, cp)
+        )
+    out = jnp.where((lse_acc > _NEG / 2)[..., None], o_acc, 0.0)
+    return out.reshape(B, H, Sl, D).astype(q.dtype)

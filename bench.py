@@ -175,6 +175,36 @@ def bench_flash_attention(S: int = 8192, pairs: int = 4, iters: int = 3):
     return ratio, flash_s, unfused_s
 
 
+def bench_ring_hop(pairs: int = 4, iters: int = 5):
+    """One ring-attention hop (the per-step block compute ring attention
+    repeats cp times): Pallas flash kernel vs the jnp online-softmax hop, at
+    a long-context shard shape. Returns ratio jnp/flash (>1 = flash wins)."""
+    from beforeholiday_tpu.ops.attention import flash_attention_with_lse
+
+    BH, Sl, D = 32, 2048, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (BH, Sl, D), jnp.bfloat16) for kk in ks)
+    sc = 1.0 / np.sqrt(D)
+
+    flash_hop = jax.jit(lambda q, k, v: flash_attention_with_lse(
+        q, k, v, causal=False, scale=sc))
+
+    @jax.jit
+    def jnp_hop(q, k, v):
+        s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * sc
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+        return acc / l, (m[..., 0] + jnp.log(l[..., 0]))
+
+    ratio, _, flash_s = _paired_ratio(
+        jnp_hop, (q, k, v), flash_hop, (q, k, v), pairs=pairs, iters=iters
+    )
+    return ratio, flash_s
+
+
 def _first_candidate(candidates, run_one, label):
     """Try (tag, cfg) candidates largest-first; return (result, tag) from the
     first that runs, logging each failure's class AND message to stderr (the
@@ -469,6 +499,11 @@ def main():
         detail["flash_attn_note"] = (
             "unfused bwd uncompilable at S=8192; flash bwd runs"
         )
+
+    ring = _stage(detail, bench_ring_hop)
+    if ring:
+        detail["ring_hop_flash_vs_jnp"] = round(ring[0], 3)
+        detail["ring_hop_flash_ms"] = round(ring[1] * 1e3, 3)
 
     bert_res = _stage(detail, bench_bert_lamb)
     if bert_res and bert_res[0]:

@@ -155,3 +155,53 @@ class TestTransducer:
             x, label, jnp.array([2, 4]), jnp.array([2, 2]), V - 1
         )))(x)
         assert np.all(np.asarray(g2)[0, 2:] == 0)
+
+
+class TestPermutationSearch:
+    """Channel-permutation search (ref: permutation_lib.py): permuted 2:4
+    retains strictly more magnitude than unpermuted."""
+
+    def test_structured_weight_improves_strictly(self):
+        from beforeholiday_tpu.contrib.sparsity import (
+            permutation_search, retained_magnitude,
+        )
+
+        # adversarial grouping: all big columns land in group 0 — identity
+        # 2:4 must drop two big columns; any spreading keeps all four
+        rng = np.random.RandomState(0)
+        w = rng.randn(16, 8) * 0.01
+        w[:, :4] += np.sign(rng.randn(16, 4)) * 10.0
+        perm, val, base = permutation_search(w, exhaustive_below=9)
+        assert val > base * 1.2
+        np.testing.assert_allclose(val, retained_magnitude(w, perm), rtol=1e-12)
+
+    def test_random_weight_greedy_improves(self):
+        from beforeholiday_tpu.contrib.sparsity import permutation_search
+
+        rng = np.random.RandomState(1)
+        w = rng.randn(32, 32)
+        perm, val, base = permutation_search(w)
+        assert sorted(perm.tolist()) == list(range(32))  # a real permutation
+        assert val > base  # greedy strictly improves on generic weights
+
+    def test_never_worse_than_identity(self):
+        from beforeholiday_tpu.contrib.sparsity import permutation_search
+
+        # already-optimal weight: uniform magnitudes, nothing to gain
+        w = np.ones((8, 16))
+        perm, val, base = permutation_search(w)
+        assert val >= base - 1e-9
+
+    def test_apply_permutation_consistency(self):
+        from beforeholiday_tpu.contrib.sparsity import (
+            apply_input_permutation, create_mask, permutation_search,
+            retained_magnitude,
+        )
+
+        rng = np.random.RandomState(2)
+        w = rng.randn(16, 16).astype(np.float32)
+        perm, val, _ = permutation_search(w)
+        wp = apply_input_permutation(jnp.asarray(w), perm)
+        mask = create_mask(wp, "m4n2_1d")
+        kept = float(jnp.sum(jnp.abs(wp) * mask))
+        np.testing.assert_allclose(kept, val, rtol=1e-5)

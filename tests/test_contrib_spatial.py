@@ -188,3 +188,68 @@ class TestBottleneck:
         want = bottleneck(x, p)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5, rtol=1e-5)
+
+    def test_spatial_stride2_matches_dense(self, devices8):
+        """Stride-2 H-sharded spatial bottleneck (stage-boundary geometry,
+        with downsample) == the dense stride-2 bottleneck
+        (ref: SpatialBottleneck's strided path, bottleneck.py:380-603)."""
+        mesh = Mesh(np.asarray(devices8), ("spatial",))
+        p = init_bottleneck(jax.random.PRNGKey(1), 8, 4, 16)  # downsample on
+        x = jnp.asarray(np.random.RandomState(5).randn(2, 32, 6, 8), jnp.float32)
+
+        @functools.partial(_smap, mesh=mesh, in_specs=(P(None, "spatial"), P()),
+                           out_specs=P(None, "spatial"))
+        def run(x, p):
+            return spatial_bottleneck(x, p, axis_name="spatial", stride=2)
+
+        got = run(x, p)
+        want = bottleneck(x, p, stride=2)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_spatial_stride2_no_downsample_identity_residual_rejected(self, devices8):
+        """stride 2 with an identity residual cannot type-check (spatial dims
+        shrink); the error must be loud, not a silent shape blow-up."""
+        mesh = Mesh(np.asarray(devices8[:2]), ("spatial",))
+        p = init_bottleneck(jax.random.PRNGKey(0), 8, 4, 8, downsample=False)
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 8, 4, 8), jnp.float32)
+
+        @functools.partial(_smap, mesh=mesh, in_specs=(P(None, "spatial"), P()),
+                           out_specs=P(None, "spatial"))
+        def run(x, p):
+            return spatial_bottleneck(x, p, axis_name="spatial", stride=2)
+
+        with pytest.raises(Exception):
+            run(x, p)
+
+    def test_spatial_stride2_odd_local_h_rejected(self, devices8):
+        mesh = Mesh(np.asarray(devices8[:2]), ("spatial",))
+        p = init_bottleneck(jax.random.PRNGKey(0), 8, 4, 16)
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 6, 4, 8), jnp.float32)
+
+        @functools.partial(_smap, mesh=mesh, in_specs=(P(None, "spatial"), P()),
+                           out_specs=P(None, "spatial"))
+        def run(x, p):
+            return spatial_bottleneck(x, p, axis_name="spatial", stride=2)
+
+        with pytest.raises(ValueError, match="even per-rank H"):
+            run(x, p)
+
+    def test_spatial_stride2_odd_width_matches_dense(self, devices8):
+        """Odd W exercises the (1,1) SAME split for the strided 3x3 — the
+        W-padding parity must follow XLA SAME, not a hardcoded (0,1)."""
+        mesh = Mesh(np.asarray(devices8), ("spatial",))
+        p = init_bottleneck(jax.random.PRNGKey(2), 8, 4, 16)
+        x = jnp.asarray(np.random.RandomState(6).randn(1, 32, 7, 8), jnp.float32)
+
+        @functools.partial(_smap, mesh=mesh, in_specs=(P(None, "spatial"), P()),
+                           out_specs=P(None, "spatial"))
+        def run(x, p):
+            return spatial_bottleneck(x, p, axis_name="spatial", stride=2)
+
+        got = run(x, p)
+        want = bottleneck(x, p, stride=2)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)

@@ -305,3 +305,32 @@ class TestLARC:
             p, state = step(p, state)
             hist.append(float(jnp.mean(p["w"] ** 2)))
         assert hist[-1] < hist[0]
+
+
+class TestSimpleDistributedExample:
+    def test_runs_on_cpu_mesh(self):
+        """The smallest DDP+amp onboarding script (the reference's
+        examples/simple/distributed) must run as-is on an 8-CPU mesh."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(
+            repo, "examples", "simple", "distributed",
+            "distributed_data_parallel.py",
+        )
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("PALLAS_AXON", "AXON"))}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8").strip()
+        env["PYTHONPATH"] = repo
+        out = subprocess.run(
+            [sys.executable, script], env=env, capture_output=True, text=True,
+            timeout=300,
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        assert "final loss" in out.stdout
+        final = float(out.stdout.strip().split()[-1])
+        assert np.isfinite(final) and final < 2.5
