@@ -16,6 +16,15 @@ becomes explicit dataflow:
   reduces to casting at the apply boundary with fp32 storage;
 * optimizer patching → a master-weights wrapper with the scaler's
   ``found_inf``/``grad_scale`` threaded through (skip-step with no host sync).
+
+Deliberately not ported: the legacy ``AmpHandle``/``OptimWrapper`` API
+(ref: apex/amp/handle.py:170-282) — deprecated in the reference itself, its
+contract is eager in-place mutation (``with handle.scale_loss(...) as s:
+s.backward()``), which has no meaning for traced functional code. Its
+capability surface survives in full: per-loss scalers = ``num_losses`` +
+``scalers``; ``scale_loss`` = ``scaled_value_and_grad``; the deprecated
+``half_function`` registrations = ``amp.functional``'s tagged ops; the even
+older explicit-master vintage = ``beforeholiday_tpu.fp16_utils``.
 """
 
 from __future__ import annotations
