@@ -86,7 +86,17 @@ def segment_ids_of(spec: ArenaSpec, idx: jax.Array) -> jax.Array:
     42M arena — the compile-time OOM this replaced).
     """
     sizes = [int(np.prod(s)) if s else 1 for s in spec.shapes]
-    boundaries = jnp.asarray(np.cumsum(sizes, dtype=np.int64), dtype=jnp.int32)
+    cum = np.cumsum(sizes, dtype=np.int64)
+    if spec.padded_total >= 2**31:
+        # int32 boundaries (and int32 idx positions, which legitimately span
+        # the PADDED arena — the ZeRO shard path indexes up to padded_total-1)
+        # silently wrap past 2^31 elements
+        raise ValueError(
+            f"arena spans {spec.padded_total} padded elements, >= 2**31 — "
+            "segment_ids_of's int32 positions would overflow; split into "
+            "smaller arenas"
+        )
+    boundaries = jnp.asarray(cum, dtype=jnp.int32)
     return jnp.sum(
         idx[:, None] >= boundaries[None, :], axis=1, dtype=jnp.int32
     )

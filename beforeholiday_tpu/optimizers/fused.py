@@ -632,6 +632,16 @@ class MasterWeights:
     def _bucket_layout(leaves):
         buckets: Dict[Any, List[int]] = {}
         for i, p in enumerate(leaves):
+            if not jnp.issubdtype(p.dtype, jnp.floating):
+                # an int leaf flattened into an fp32 master arena would be
+                # Adam-updated and written back truncated — silent corruption;
+                # the tree path skips non-floats (cast_floats), so match that
+                # contract loudly here
+                raise ValueError(
+                    f"arena=True cannot optimize non-floating param leaf "
+                    f"#{i} (dtype {p.dtype}); keep integer leaves out of the "
+                    "optimized tree or use the list-based MasterWeights"
+                )
             buckets.setdefault(jnp.dtype(p.dtype), []).append(i)
         return sorted(buckets.items(), key=lambda kv: kv[0].name)
 

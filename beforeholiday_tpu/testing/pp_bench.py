@@ -35,7 +35,7 @@ S = 4  # pipeline stages
 
 def stage_fn(sp, x):
     h = jax.nn.gelu(x @ sp["w1"] + sp["b1"])
-    return h @ sp["w2"] + x
+    return h @ sp["w2"] + sp["b2"] + x
 
 
 def loss_fn(y, tgt):
@@ -43,12 +43,13 @@ def loss_fn(y, tgt):
 
 
 def init_stages(key):
-    ks = jax.random.split(key, 4)
+    ks = jax.random.split(key, 2)
     s = 1.0 / np.sqrt(HIDDEN)
     return {
         "w1": jax.random.normal(ks[0], (S, HIDDEN, 4 * HIDDEN)) * s,
         "b1": jnp.zeros((S, 4 * HIDDEN)),
         "w2": jax.random.normal(ks[1], (S, 4 * HIDDEN, HIDDEN)) * s,
+        "b2": jnp.zeros((S, HIDDEN)),
     }
 
 

@@ -125,6 +125,14 @@ def _pipelined_fwd_bwd(
     S = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     M = inputs.shape[0]
+    # JAX clamps traced out-of-bounds indexing, so a mismatched microbatch
+    # count would silently reuse the last target microbatch (targets[m_b]
+    # below is clip-indexed) — fail loudly on the static shapes instead
+    if targets.shape[0] != M:
+        raise ValueError(
+            f"microbatch-count mismatch: inputs has {M} microbatches but "
+            f"targets has {targets.shape[0]}; both must agree"
+        )
     # S (axis_size) is static inside shard_map, so the tick equations trace.
     # M % S == 0 is the reference's interleaving contract
     # (fwd_bwd_pipelining_with_interleaving.py asserts it); V=1 has no
@@ -457,6 +465,15 @@ def forward_backward_pipelining_encoder_decoder(
         )
     rank = jax.lax.axis_index(axis_name)
     M = enc_inputs.shape[0]
+    # JAX clamps traced out-of-bounds indexing, so a mismatched microbatch
+    # count would silently reuse the last dec/target microbatch and produce
+    # wrong losses — fail loudly on the static shapes instead
+    if dec_inputs.shape[0] != M or targets.shape[0] != M:
+        raise ValueError(
+            f"microbatch-count mismatch: enc_inputs has {M} microbatches but "
+            f"dec_inputs has {dec_inputs.shape[0]} and targets "
+            f"{targets.shape[0]}; all three must agree"
+        )
     total_ticks = M + 2 * S - 1
     ring_depth = 2 * S
 
