@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from beforeholiday_tpu.amp.scaler import LossScaler
 from beforeholiday_tpu.ops._autocast import autocast, cast_floats as _cast_floats
+from beforeholiday_tpu.ops.arena import PackedParams
 from beforeholiday_tpu.optimizers.fused import MasterWeights
 from beforeholiday_tpu.utils.logging import get_logger
 
@@ -182,6 +183,7 @@ def initialize(
     has_state: bool = False,
     num_losses: int = 1,
     arena_masters: bool = False,
+    arena_native: bool = False,
 ) -> AmpModel:
     """Apply an opt-level policy to (apply_fn, params, optimizer).
 
@@ -204,6 +206,15 @@ def initialize(
     ``num_losses`` creates one independent LossScaler per loss (ref:
     _initialize.py:229-233) — GAN-style multi-loss training scales each loss
     with its own dynamic state; all land in ``state_dict`` as loss_scaler{i}.
+
+    ``arena_native=True`` (implies ``arena_masters``) stores the cast params
+    as :class:`PackedParams` — per-dtype flat HBM arenas. ``AmpModel.apply``
+    unpacks transparently (static slices XLA fuses into consumers), so
+    ``jax.grad`` taken at the packed argument returns gradient ARENAS and the
+    master-weight optimizer step runs with ZERO per-step packing — the TPU
+    equivalent of the reference's pointer-aliased tensor lists
+    (csrc/multi_tensor_apply.cuh never repacks either). Single-device /
+    manual-shard_map fast path, like ``arena_masters``.
     """
     if opt_level not in opt_levels:
         raise RuntimeError(
@@ -223,6 +234,21 @@ def initialize(
     logger.info("amp.initialize: %s", policy)
 
     cast_params = _cast_params(params, policy, keep_fp32_mask)
+    if arena_native:
+        if policy.patch_torch_functions or (
+            optimizer is not None and not policy.master_weights
+        ):
+            # without the MasterWeights wrap a raw optimizer would consume the
+            # PackedParams pytree as 1-2 arena "leaves" — LAMB/LARS/NovoGrad
+            # per-TENSOR norms and weight-decay masks would silently apply
+            # per-ARENA; only the master-weight levels route the packed step
+            raise ValueError(
+                "arena_native requires a master-weights opt level (O2/O5, or "
+                f"master_weights=True); {policy.opt_level} with "
+                f"master_weights={policy.master_weights} would hand "
+                "PackedParams to the raw optimizer"
+            )
+        cast_params = PackedParams.pack(cast_params)
     amp_apply = make_apply(
         policy, apply_fn, cast_model_outputs=cast_model_outputs,
         has_state=has_state, keep_fp32_mask=keep_fp32_mask,
@@ -232,8 +258,10 @@ def initialize(
     if opt is not None and policy.master_weights:
         # arena_masters keeps fp32 masters + optimizer state packed flat and
         # fuses the master->model cast into the optimizer kernel (single-device
-        # / manual-shard_map fast path; see MasterWeights docstring)
-        opt = MasterWeights(opt, arena=arena_masters)
+        # / manual-shard_map fast path; see MasterWeights docstring);
+        # MasterWeights.step dispatches on PackedParams for the arena-native
+        # zero-packing path
+        opt = MasterWeights(opt, arena=arena_masters or arena_native)
 
     if num_losses < 1:
         raise ValueError(f"num_losses must be >= 1, got {num_losses}")
@@ -274,6 +302,8 @@ def make_apply(
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def amp_apply(p, *inputs, **kwinputs):
+        if isinstance(p, PackedParams):
+            p = p.unpack()  # static slices — fused into consumers under jit
         if has_state:
             model_state, *inputs = inputs
         if policy.patch_torch_functions:

@@ -12,7 +12,13 @@
 * ``attention`` — Pallas flash attention (``fmhalib``, ``fast_multihead_attn``).
 """
 
-from .arena import ArenaSpec, flatten, make_spec, unflatten  # noqa: F401
+from .arena import (  # noqa: F401
+    ArenaSpec,
+    PackedParams,
+    flatten,
+    make_spec,
+    unflatten,
+)
 from .multi_tensor import (  # noqa: F401
     adam_flat,
     lamb_flat,

@@ -182,3 +182,114 @@ def as_rows(flat: jax.Array) -> jax.Array:
     """View a padded flat arena as (rows, LANES) for lane-aligned kernels."""
     assert flat.shape[0] % LANES == 0, "arena must be padded to LANES"
     return flat.reshape(-1, LANES)
+
+
+# ---------------------------------------------------------------------------------
+# PackedParams — arena-NATIVE parameter storage (grads born flat)
+# ---------------------------------------------------------------------------------
+
+
+def bucket_by_dtype(leaves: Sequence[jax.Array]):
+    """Partition leaf indices into per-dtype buckets, sorted by dtype name —
+    THE bucketing contract shared by :class:`PackedParams` and
+    ``MasterWeights``'s arena mode (gradient arenas must align
+    bucket-for-bucket with master/optimizer-state arenas, so both sides call
+    this one function). Rejects non-floating leaves: an int leaf flattened
+    into an fp32 arena would be optimizer-updated and written back truncated
+    — silent corruption (the tree path skips non-floats via cast_floats)."""
+    buckets: dict = {}
+    for i, p in enumerate(leaves):
+        if not jnp.issubdtype(p.dtype, jnp.floating):
+            raise ValueError(
+                f"cannot pack non-floating leaf #{i} (dtype {p.dtype}) into "
+                "a parameter arena; keep integer leaves out of the optimized "
+                "tree"
+            )
+        buckets.setdefault(jnp.dtype(p.dtype), []).append(i)
+    return sorted(buckets.items(), key=lambda kv: kv[0].name)
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedLayout:
+    """Static layout of a params pytree packed into per-dtype arenas.
+
+    Hashable (all-static) so it can ride a pytree aux_data / jit static arg.
+    Buckets are sorted by dtype name — the same order ``MasterWeights``'s
+    arena mode uses, so packed grads align bucket-for-bucket with the
+    optimizer's master/state arenas.
+    """
+
+    treedef: Any
+    dtypes: Tuple[Any, ...]  # one jnp.dtype per bucket
+    indices: Tuple[Tuple[int, ...], ...]  # leaf indices per bucket
+    specs: Tuple[ArenaSpec, ...]  # arena spec per bucket
+    n_leaves: int
+
+
+@jax.tree_util.register_pytree_node_class
+class PackedParams:
+    """A params pytree stored as per-dtype flat HBM arenas.
+
+    The arena-native answer to the reference's aliased tensor lists
+    (ref: csrc/multi_tensor_apply.cuh:19-147 — CUDA kernels walk raw pointers
+    into the ORIGINAL storage, so the optimizer never repacks). Under XLA
+    there is no aliasing, so the equivalent is to make the flat arena the
+    source of truth: the model's parameters ARE the arenas, ``unpack()``
+    produces the leaf views (static slices XLA fuses into consumers), and
+    ``jax.grad`` of a loss taken at a ``PackedParams`` argument returns the
+    gradient ARENAS directly — grads are born flat, and the fused optimizers'
+    ``step_flat`` consumes them with zero per-step packing.
+
+    Registered as a pytree: arenas are the children (traced), the layout is
+    static aux data. Works as a jit/grad argument transparently.
+    """
+
+    __slots__ = ("arenas", "layout")
+
+    def __init__(self, arenas: Sequence[jax.Array], layout: PackedLayout):
+        self.arenas = tuple(arenas)
+        self.layout = layout
+
+    def tree_flatten(self):
+        return self.arenas, self.layout
+
+    @classmethod
+    def tree_unflatten(cls, layout, arenas):
+        return cls(arenas, layout)
+
+    @classmethod
+    def pack(cls, tree: Any) -> "PackedParams":
+        """One-time pack (init/checkpoint-load boundary, never per-step)."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        arenas, dtypes, indices, specs = [], [], [], []
+        for dtype, idx in bucket_by_dtype(leaves):
+            flat, spec = flatten([leaves[i] for i in idx])
+            arenas.append(flat)
+            dtypes.append(dtype)
+            indices.append(tuple(idx))
+            specs.append(spec)
+        layout = PackedLayout(
+            treedef=treedef, dtypes=tuple(dtypes), indices=tuple(indices),
+            specs=tuple(specs), n_leaves=len(leaves),
+        )
+        return cls(arenas, layout)
+
+    def unpack(self) -> Any:
+        """Rebuild the leaf pytree as static slices of the arenas.
+
+        Under jit the slices fuse into their consumers (see ``unflatten``) —
+        this is a per-step view, not a per-step copy.
+        """
+        lay = self.layout
+        leaves: List[Any] = [None] * lay.n_leaves
+        for arena_buf, idx, spec in zip(self.arenas, lay.indices, lay.specs):
+            for i, piece in zip(idx, unflatten(arena_buf, spec)):
+                leaves[i] = piece
+        return jax.tree_util.tree_unflatten(lay.treedef, leaves)
+
+    def replace_arenas(self, arenas: Sequence[jax.Array]) -> "PackedParams":
+        if len(arenas) != len(self.arenas):
+            raise ValueError(
+                f"expected {len(self.arenas)} arenas, got {len(arenas)}"
+            )
+        return PackedParams(arenas, self.layout)

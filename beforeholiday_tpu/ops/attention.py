@@ -92,8 +92,36 @@ def _mask(causal, i, j, lens, shape, bq, bk):
     return masked
 
 
-def _fa_fwd_kernel(causal, scale, nk, bq, bk, lens_ref, q_ref, k_ref, v_ref,
-                   o_ref, lse_ref, acc_ref, m_ref, l_ref):
+def _keep_mask(seed_ref, b, i, j, nq, nk, shape, keep_prob):
+    """In-kernel dropout keep-mask for score block (b, i, j) — the TPU
+    counterpart of the reference's curand path in its fused kernels
+    (ref: apex/contrib/csrc/multihead_attn/dropout.cuh:1-272, consumed by
+    every *_func variant, self_multihead_attn_func.py:148-186).
+
+    The PRNG is RE-SEEDED per (batch*head, q-block, k-block) from the caller's
+    seed plus a mixed block id, then one (BQ, BK) draw is taken — so the
+    forward and BOTH backward kernels regenerate the exact same mask for a
+    block regardless of their different grid orders, the same
+    counter-per-block contract as Philox offsets in the reference."""
+    block_id = (b * nq + i) * nk + j
+    # Knuth multiplicative mix: adjacent block ids land far apart in seed
+    # space (raw adjacent seeds risk correlated low bits)
+    pltpu.prng_seed(seed_ref[0], block_id * -1640531527)
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    # top 24 bits -> [0, 1): the shifted value fits int32, which IS castable
+    # to f32 on the VPU (a direct uint32->f32 cast is not)
+    u = pltpu.bitcast(bits >> 8, jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
+    return u < keep_prob
+
+
+def _fa_fwd_kernel(causal, scale, nq, nk, bq, bk, rate, *refs):
+    if rate > 0.0:
+        (lens_ref, seed_ref, q_ref, k_ref, v_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        (lens_ref, q_ref, k_ref, v_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+        seed_ref = None
     b, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     seq_len = lens_ref[b]
 
@@ -122,9 +150,18 @@ def _fa_fwd_kernel(causal, scale, nk, bq, bk, lens_ref, q_ref, k_ref, v_ref,
         # explicit zero on masked slots: when a whole row is masked s == m_new
         # == _NEG and exp(s - m) would be 1, not 0
         p = jnp.where(masked, 0.0, jnp.exp(s - m_new[:, 0:1]))
+        # the softmax normalizer l accumulates the UNDROPPED p: out_i =
+        # (1/l_i) sum_j mask_ij/keep * p_ij v_j == softmax->dropout->matmul
+        # (torch's order, self_multihead_attn_func.py:148-186) — dropping
+        # after normalization, expressed online
         l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            keep = _keep_mask(seed_ref, b, i, j, nq, nk, p.shape, 1.0 - rate)
+            pd = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+        else:
+            pd = p
         acc_ref[...] = acc_ref[...] * alpha[:, 0:1] + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0],
+            pd.astype(v_ref.dtype), v_ref[0],
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
         m_ref[...] = m_new
@@ -142,22 +179,26 @@ def _fa_fwd_kernel(causal, scale, nk, bq, bk, lens_ref, q_ref, k_ref, v_ref,
         )
 
 
-def _fa_fwd_pallas(q, k, v, lens, causal, scale, interpret):
+def _fa_fwd_pallas(q, k, v, lens, causal, scale, interpret, rate=0.0, seed=None):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _block_size(Sq, D), _block_size(Sk, D)
     nq, nk = Sq // bq, Sk // bk
-    # lens rides scalar-prefetch SMEM (a (1,1)-blocked SMEM operand fails
-    # Mosaic's tiling check); index maps receive the scalar ref last
-    qspec = pl.BlockSpec((1, bq, D), lambda b, i, j, lens_ref: (b, i, 0))
-    kspec = pl.BlockSpec((1, bk, D), lambda b, i, j, lens_ref: (b, j, 0))
+    # lens (and the dropout seed when active) ride scalar-prefetch SMEM (a
+    # (1,1)-blocked SMEM operand fails Mosaic's tiling check); index maps
+    # receive the scalar refs last — *_ absorbs however many there are
+    qspec = pl.BlockSpec((1, bq, D), lambda b, i, j, *_: (b, i, 0))
+    kspec = pl.BlockSpec((1, bk, D), lambda b, i, j, *_: (b, j, 0))
+    scalars = [lens.astype(jnp.int32)]
+    if rate > 0.0:
+        scalars.append(seed.astype(jnp.int32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=len(scalars),
         grid=(BH, nq, nk),
         in_specs=[qspec, kspec, kspec],
         out_specs=[
             qspec,
-            pl.BlockSpec((1, bq, 128), lambda b, i, j, lens_ref: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j, *_: (b, i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -166,7 +207,7 @@ def _fa_fwd_pallas(q, k, v, lens, causal, scale, interpret):
         ],
     )
     o, lse = pl.pallas_call(
-        functools.partial(_fa_fwd_kernel, causal, scale, nk, bq, bk),
+        functools.partial(_fa_fwd_kernel, causal, scale, nq, nk, bq, bk, rate),
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
@@ -176,7 +217,7 @@ def _fa_fwd_pallas(q, k, v, lens, causal, scale, interpret):
             jax.ShapeDtypeStruct((BH, Sq, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(lens.astype(jnp.int32), q, k, v)
+    )(*scalars, q, k, v)
     return o, lse
 
 
@@ -186,14 +227,24 @@ def _fa_fwd_pallas(q, k, v, lens, causal, scale, interpret):
 # ---------------------------------------------------------------------------------
 
 
-def _block_p_ds(causal, scale, i, j, lens, q, k, v, do, o, lse, dlse, bq, bk):
-    """Shared recompute: probabilities p and score-grad ds for block (i, j).
-    ``lse``/``dlse``: (BQ, 128) lane-replicated; delta_i = rowsum(dO_i * O_i)
-    is recomputed here from the o/do blocks (cheap VPU work vs another HBM
-    residual). ``dlse`` is the cotangent of the EXPOSED lse output (zero for
-    plain attention; nonzero when the caller merges chunk outputs by lse, as
-    ring attention does — d lse_i/d s_ij = p_ij adds dlse_i inside the
-    parens). Matmuls run in the input dtype with fp32 accumulation."""
+def _block_p_ds(causal, scale, b, i, j, lens, q, k, v, do, o, lse, dlse,
+                bq, bk, rate, nq, nk, seed_ref):
+    """Shared recompute: dv-side probabilities z and score-grad ds for block
+    (b, i, j). ``lse``/``dlse``: (BQ, 128) lane-replicated; delta_i =
+    rowsum(dO_i * O_i) is recomputed here from the o/do blocks (cheap VPU
+    work vs another HBM residual). ``dlse`` is the cotangent of the EXPOSED
+    lse output (zero for plain attention; nonzero when the caller merges
+    chunk outputs by lse, as ring attention does — d lse_i/d s_ij = p_ij
+    adds dlse_i inside the parens). Matmuls run in the input dtype with fp32
+    accumulation.
+
+    With dropout (``rate > 0``) the forward computed out_i = sum_j z_ij v_j
+    with z = keep/(1-rate) * softmax(s); the same mask regenerates here
+    (:func:`_keep_mask` is deterministic per block). The chain rule gives
+    dp~_ij = (do_i . v_j) * keep_ij/(1-rate), and the softmax-backward
+    rowsum term STAYS delta_i = do_i . o_i because
+    sum_k dp~_ik p_ik = sum_k (do.v_k) z_ik = do_i . o_i — the undropped
+    p carries the Jacobian, the dropped z carries dv."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -202,15 +253,27 @@ def _block_p_ds(causal, scale, i, j, lens, q, k, v, do, o, lse, dlse, bq, bk):
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
+    if rate > 0.0:
+        keep = _keep_mask(seed_ref, b, i, j, nq, nk, p.shape, 1.0 - rate)
+        inv = 1.0 / (1.0 - rate)
+        z = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    else:
+        z = p
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
     extra = dlse[:, 0:1] if dlse is not None else 0.0
     ds = p * (dp - delta + extra) * scale
-    return p, ds
+    return z, ds
 
 
-def _fa_dq_kernel(causal, scale, nk, bq, bk, has_dlse, lens_ref, q_ref, k_ref,
-                  v_ref, do_ref, o_ref, lse_ref, *rest):
+def _fa_dq_kernel(causal, scale, nq, nk, bq, bk, has_dlse, rate, *refs):
+    if rate > 0.0:
+        lens_ref, seed_ref, *refs = refs
+    else:
+        lens_ref, *refs = refs
+        seed_ref = None
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest = refs
     if has_dlse:
         dlse_ref, dq_ref, dq_acc = rest
     else:
@@ -227,9 +290,9 @@ def _fa_dq_kernel(causal, scale, nk, bq, bk, has_dlse, lens_ref, q_ref, k_ref,
     @pl.when(live)
     def _compute():
         _, ds = _block_p_ds(
-            causal, scale, i, j, lens_ref[b],
+            causal, scale, b, i, j, lens_ref[b],
             q_ref[0], k_ref[0], v_ref[0], do_ref[0], o_ref[0], lse_ref[0],
-            dlse_ref[0] if has_dlse else None, bq, bk,
+            dlse_ref[0] if has_dlse else None, bq, bk, rate, nq, nk, seed_ref,
         )
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0],
@@ -241,8 +304,13 @@ def _fa_dq_kernel(causal, scale, nk, bq, bk, has_dlse, lens_ref, q_ref, k_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _fa_dkv_kernel(causal, scale, nq, bq, bk, has_dlse, lens_ref, q_ref, k_ref,
-                   v_ref, do_ref, o_ref, lse_ref, *rest):
+def _fa_dkv_kernel(causal, scale, nq, nk, bq, bk, has_dlse, rate, *refs):
+    if rate > 0.0:
+        lens_ref, seed_ref, *refs = refs
+    else:
+        lens_ref, *refs = refs
+        seed_ref = None
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest = refs
     if has_dlse:
         dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
@@ -260,13 +328,16 @@ def _fa_dkv_kernel(causal, scale, nq, bq, bk, has_dlse, lens_ref, q_ref, k_ref,
 
     @pl.when(live)
     def _compute():
-        p, ds = _block_p_ds(
-            causal, scale, i, j, lens_ref[b],
+        z, ds = _block_p_ds(
+            causal, scale, b, i, j, lens_ref[b],
             q_ref[0], k_ref[0], v_ref[0], do_ref[0], o_ref[0], lse_ref[0],
-            dlse_ref[0] if has_dlse else None, bq, bk,
+            dlse_ref[0] if has_dlse else None, bq, bk, rate, nq, nk, seed_ref,
         )
+        # dv sees the DROPPED probabilities z (dropout sits between softmax
+        # and the @v matmul); dk/dq flow through ds, whose rowsum term keeps
+        # the undropped p Jacobian — see _block_p_ds
         dv_acc[...] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[0],
+            z.astype(do_ref.dtype), do_ref[0],
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
         dk_acc[...] += jax.lax.dot_general(
@@ -280,7 +351,8 @@ def _fa_dkv_kernel(causal, scale, nq, bq, bk, has_dlse, lens_ref, q_ref, k_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _fa_bwd_pallas(q, k, v, do, o, lse, dlse, lens, causal, scale, interpret):
+def _fa_bwd_pallas(q, k, v, do, o, lse, dlse, lens, causal, scale, interpret,
+                   rate=0.0, seed=None):
     """``dlse=None`` (the plain-attention path) omits the operand entirely —
     an all-zero lane-replicated dlse would otherwise add an arena-sized HBM
     read to BOTH backward kernels for nothing."""
@@ -290,14 +362,17 @@ def _fa_bwd_pallas(q, k, v, do, o, lse, dlse, lens, causal, scale, interpret):
     nq, nk = Sq // bq, Sk // bk
     has_dlse = dlse is not None
     dlse_ops = (dlse,) if has_dlse else ()
-    lens_i = lens.astype(jnp.int32)
-    qspec_i = pl.BlockSpec((1, bq, D), lambda b, i, j, lens_ref: (b, i, 0))
-    kspec_j = pl.BlockSpec((1, bk, D), lambda b, i, j, lens_ref: (b, j, 0))
-    lse_i = pl.BlockSpec((1, bq, 128), lambda b, i, j, lens_ref: (b, i, 0))
+    scalars = [lens.astype(jnp.int32)]
+    if rate > 0.0:
+        scalars.append(seed.astype(jnp.int32))
+    qspec_i = pl.BlockSpec((1, bq, D), lambda b, i, j, *_: (b, i, 0))
+    kspec_j = pl.BlockSpec((1, bk, D), lambda b, i, j, *_: (b, j, 0))
+    lse_i = pl.BlockSpec((1, bq, 128), lambda b, i, j, *_: (b, i, 0))
     dq = pl.pallas_call(
-        functools.partial(_fa_dq_kernel, causal, scale, nk, bq, bk, has_dlse),
+        functools.partial(_fa_dq_kernel, causal, scale, nq, nk, bq, bk,
+                          has_dlse, rate),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=len(scalars),
             grid=(BH, nq, nk),
             in_specs=[qspec_i, kspec_j, kspec_j, qspec_i, qspec_i, lse_i]
                      + ([lse_i] if has_dlse else []),
@@ -309,16 +384,17 @@ def _fa_bwd_pallas(q, k, v, do, o, lse, dlse, lens, causal, scale, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(lens_i, q, k, v, do, o, lse, *dlse_ops)
+    )(*scalars, q, k, v, do, o, lse, *dlse_ops)
 
     # dkv grid: (BH, k-block, q-block) — q-side operands indexed by the INNER id
-    qspec_in = pl.BlockSpec((1, bq, D), lambda b, j, i, lens_ref: (b, i, 0))
-    kspec_out = pl.BlockSpec((1, bk, D), lambda b, j, i, lens_ref: (b, j, 0))
-    lse_in = pl.BlockSpec((1, bq, 128), lambda b, j, i, lens_ref: (b, i, 0))
+    qspec_in = pl.BlockSpec((1, bq, D), lambda b, j, i, *_: (b, i, 0))
+    kspec_out = pl.BlockSpec((1, bk, D), lambda b, j, i, *_: (b, j, 0))
+    lse_in = pl.BlockSpec((1, bq, 128), lambda b, j, i, *_: (b, i, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_fa_dkv_kernel, causal, scale, nq, bq, bk, has_dlse),
+        functools.partial(_fa_dkv_kernel, causal, scale, nq, nk, bq, bk,
+                          has_dlse, rate),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=len(scalars),
             grid=(BH, nk, nq),
             in_specs=[qspec_in, kspec_out, kspec_out, qspec_in, qspec_in, lse_in]
                      + ([lse_in] if has_dlse else []),
@@ -336,7 +412,7 @@ def _fa_bwd_pallas(q, k, v, do, o, lse, dlse, lens, causal, scale, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(lens_i, q, k, v, do, o, lse, *dlse_ops)
+    )(*scalars, q, k, v, do, o, lse, *dlse_ops)
     return dq, dk, dv
 
 
@@ -345,23 +421,26 @@ def _fa_bwd_pallas(q, k, v, do, o, lse, dlse, lens, causal, scale, interpret):
 # ---------------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash3(q, k, v, lens, causal, scale):
-    o, _ = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default())
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash3(q, k, v, lens, seed, causal, scale, rate):
+    o, _ = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default(),
+                          rate, seed)
     return o
 
 
-def _flash3_fwd(q, k, v, lens, causal, scale):
-    o, lse = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default())
-    return o, (q, k, v, lens, o, lse)
+def _flash3_fwd(q, k, v, lens, seed, causal, scale, rate):
+    o, lse = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default(),
+                            rate, seed)
+    return o, (q, k, v, lens, seed, o, lse)
 
 
-def _flash3_bwd(causal, scale, res, do):
-    q, k, v, lens, o, lse = res
+def _flash3_bwd(causal, scale, rate, res, do):
+    q, k, v, lens, seed, o, lse = res
     dq, dk, dv = _fa_bwd_pallas(
-        q, k, v, do, o, lse, None, lens, causal, scale, _interpret_default()
+        q, k, v, do, o, lse, None, lens, causal, scale, _interpret_default(),
+        rate, seed,
     )
-    return dq, dk, dv, jnp.zeros_like(lens)
+    return dq, dk, dv, jnp.zeros_like(lens), jnp.zeros_like(seed)
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
@@ -392,6 +471,14 @@ def _flash3_lse_bwd(causal, scale, res, cts):
 
 
 _flash3_lse.defvjp(_flash3_lse_fwd, _flash3_lse_bwd)
+
+
+def _seed_from_key(key: jax.Array) -> jax.Array:
+    """(1,) int32 kernel seed derived from a PRNG key — the key stays the
+    user-facing contract (fold_in composability with the RNG tracker), the
+    kernel consumes a raw counter seed like the reference's Philox offset."""
+    bits = jax.random.bits(key, (1,), jnp.uint32)
+    return jax.lax.bitcast_convert_type(bits, jnp.int32)
 
 
 def flash_attention_with_lse(q3, k3, v3, *, causal, scale, kv_lens=None):
@@ -464,11 +551,13 @@ def flash_attention(
     ``dropout_rate``/``dropout_key``: attention-probability dropout in
     torch's softmax->dropout->matmul order (ref:
     apex/contrib/multihead_attn/self_multihead_attn.py:32 ``dropout=`` and
-    dropout.cuh). Currently served by the jnp path — a dropout request
-    dispatches there even on TPU (the Pallas kernel has no in-kernel PRNG
-    yet), so long-sequence training with attention dropout pays the
-    materialized-scores cost. Hidden/residual dropout (the dominant
-    regularizers) are elementwise and unaffected.
+    dropout.cuh). On TPU the Pallas kernel drops IN-KERNEL via the hardware
+    PRNG (deterministic per-block reseeding, so forward and backward
+    regenerate identical masks — see :func:`_keep_mask`), keeping the O(S)
+    memory profile for long-sequence training. The jnp oracle path uses
+    ``jax.random.bernoulli`` (a different RNG stream: same distribution, not
+    the same draws). Interpret mode (CPU tests) has no PRNG lowering and
+    falls back to jnp.
     """
     if q.ndim != 4:
         raise ValueError(f"expected (B, H, S, D) inputs, got {q.shape}")
@@ -490,11 +579,14 @@ def flash_attention(
         raise ValueError("dropout_rate > 0 requires a dropout_key")
     forced = impl is not None
     impl = _resolve_impl(impl)
-    if impl == "pallas" and dropout_rate > 0.0:
+    if impl == "pallas" and dropout_rate > 0.0 and _interpret_default():
+        # the in-kernel PRNG has no interpret-mode lowering; CPU test runs
+        # take the jnp path (same distribution, different draws)
         if forced:
             raise ValueError(
-                "impl='pallas' has no in-kernel dropout; pass impl=None for "
-                "the jnp dropout path or apply dropout outside attention"
+                "impl='pallas' with dropout needs a real TPU (the Pallas "
+                "interpreter has no PRNG lowering); pass impl=None for the "
+                "jnp dropout path"
             )
         impl = "jnp"
     if impl == "pallas" and not (
@@ -522,7 +614,12 @@ def flash_attention(
     v3 = v.reshape(B * H, Sk, D)
     with jax.named_scope("flash_attention"):  # XProf range (NVTX idiom)
         if impl == "pallas":
-            o = _flash3(q3, k3, v3, lens_bh, causal, scale)
+            if dropout_rate > 0.0:
+                seed = _seed_from_key(dropout_key)
+            else:
+                seed = jnp.zeros((1,), jnp.int32)
+            o = _flash3(q3, k3, v3, lens_bh, seed, causal, scale,
+                        float(dropout_rate))
         else:
             o = _attn_jnp(q3, k3, v3, lens_bh, causal, scale,
                           dropout_rate, dropout_key)

@@ -27,7 +27,13 @@ import jax
 import jax.numpy as jnp
 
 from beforeholiday_tpu.ops import multi_tensor as mt
-from beforeholiday_tpu.ops.arena import ArenaSpec, flatten as _arena_flatten, unflatten as _arena_unflatten
+from beforeholiday_tpu.ops.arena import (
+    ArenaSpec,
+    PackedParams,
+    bucket_by_dtype as _bucket_by_dtype,
+    flatten as _arena_flatten,
+    unflatten as _arena_unflatten,
+)
 from beforeholiday_tpu.ops._autocast import cast_floats as _cast_floats
 
 Mask = Union[None, Any, Callable[[Tuple[Any, ...]], bool]]
@@ -627,25 +633,20 @@ class MasterWeights:
         self.arena = arena
 
     # dtype buckets, derived from the (static) param tree every call — no
-    # hidden instance state, so step() stays pure under jit
-    @staticmethod
-    def _bucket_layout(leaves):
-        buckets: Dict[Any, List[int]] = {}
-        for i, p in enumerate(leaves):
-            if not jnp.issubdtype(p.dtype, jnp.floating):
-                # an int leaf flattened into an fp32 master arena would be
-                # Adam-updated and written back truncated — silent corruption;
-                # the tree path skips non-floats (cast_floats), so match that
-                # contract loudly here
-                raise ValueError(
-                    f"arena=True cannot optimize non-floating param leaf "
-                    f"#{i} (dtype {p.dtype}); keep integer leaves out of the "
-                    "optimized tree or use the list-based MasterWeights"
-                )
-            buckets.setdefault(jnp.dtype(p.dtype), []).append(i)
-        return sorted(buckets.items(), key=lambda kv: kv[0].name)
+    # hidden instance state, so step() stays pure under jit. ONE shared
+    # bucketing function with PackedParams.pack: gradient arenas must align
+    # bucket-for-bucket with the master/state arenas built here.
+    _bucket_layout = staticmethod(_bucket_by_dtype)
 
     def init(self, params):
+        if isinstance(params, PackedParams):
+            # arena-NATIVE: the model already lives flat (grads will be born
+            # flat too) — masters are a straight per-bucket cast, no packing
+            masters = tuple(a.astype(jnp.float32) for a in params.arenas)
+            return {
+                "inner": tuple(self.inner.init_flat(m) for m in masters),
+                "master": masters,
+            }
         if not self.arena:
             master = _cast_floats(params, jnp.float32)
             return {"inner": self.inner.init(master), "master": master}
@@ -658,6 +659,10 @@ class MasterWeights:
         return {"inner": tuple(inners), "master": tuple(masters)}
 
     def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
+        if isinstance(params, PackedParams):
+            return self._step_packed(
+                params, grads, state, found_inf=found_inf, grad_scale=grad_scale, **kw
+            )
         if self.arena:
             return self._step_arena(
                 params, grads, state, found_inf=found_inf, grad_scale=grad_scale, **kw
@@ -674,9 +679,53 @@ class MasterWeights:
         )
         return new_params, {"inner": new_inner, "master": new_master}
 
-    def _step_arena(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
+    def _global_norm_extra(self, flat_grads, grad_scale):
+        """norm-clipping optimizers (LAMB) need ONE global grad norm across
+        every dtype bucket — per-bucket norms would clip each bucket by its
+        own magnitude and silently diverge from the list path on the
+        standard bf16+keep-fp32-norms layout"""
         import inspect
 
+        if "global_grad_norm" not in inspect.signature(self.inner.step_flat).parameters:
+            return {}
+        total_sq = sum(
+            jnp.sum((gf.astype(jnp.float32) * grad_scale) ** 2)
+            for gf in flat_grads
+        )
+        return {"global_grad_norm": jnp.sqrt(total_sq)}
+
+    def _step_packed(self, params, grads, state, *, found_inf=None,
+                     grad_scale=1.0, **kw):
+        """Arena-native step: model AND grads are already flat (PackedParams
+        from a ``jax.grad`` taken at a packed argument) — one fused kernel
+        pass per dtype bucket, NO per-step packing anywhere. This is the
+        moral equivalent of the reference's pointer-aliased tensor lists
+        (csrc/multi_tensor_apply.cuh): the optimizer touches original
+        storage."""
+        if not isinstance(grads, PackedParams):
+            raise ValueError(
+                "packed step needs PackedParams grads (take jax.grad at a "
+                "PackedParams argument so grads are born flat)"
+            )
+        if grads.layout != params.layout:
+            raise ValueError("params/grads PackedParams layouts differ")
+        lay = params.layout
+        masters, inners, model_arenas = [], [], []
+        extra = self._global_norm_extra(grads.arenas, grad_scale)
+        for b, dtype in enumerate(lay.dtypes):
+            copy_dtype = None if dtype == jnp.float32 else dtype
+            outs = self.inner.step_flat(
+                state["master"][b], grads.arenas[b], state["inner"][b],
+                spec=lay.specs[b], found_inf=found_inf, grad_scale=grad_scale,
+                model_copy_dtype=copy_dtype, **extra, **kw,
+            )
+            masters.append(outs[0])
+            inners.append(outs[1])
+            model_arenas.append(outs[2] if copy_dtype is not None else outs[0])
+        new_params = params.replace_arenas(model_arenas)
+        return new_params, {"inner": tuple(inners), "master": tuple(masters)}
+
+    def _step_arena(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
         pleaves, treedef = jax.tree_util.tree_flatten(params)
         gleaves = jax.tree_util.tree_leaves(grads)
         if len(pleaves) != len(gleaves):
@@ -687,17 +736,7 @@ class MasterWeights:
         flat_grads = [
             _arena_flatten([gleaves[i] for i in idx]) for _, idx in layout
         ]
-        # norm-clipping optimizers (LAMB) need ONE global grad norm across
-        # every dtype bucket — per-bucket norms would clip each bucket by its
-        # own magnitude and silently diverge from the list path on the
-        # standard bf16+keep-fp32-norms layout
-        extra = {}
-        if "global_grad_norm" in inspect.signature(self.inner.step_flat).parameters:
-            total_sq = sum(
-                jnp.sum((gf.astype(jnp.float32) * grad_scale) ** 2)
-                for gf, _ in flat_grads
-            )
-            extra["global_grad_norm"] = jnp.sqrt(total_sq)
+        extra = self._global_norm_extra([gf for gf, _ in flat_grads], grad_scale)
 
         new_leaves = list(pleaves)
         masters, inners = [], []

@@ -6,16 +6,27 @@ the TPU-native default) vs the self-generated O0 fp32 baseline on the same
 hardware — the reference publishes no numbers (BASELINE.md), so the baseline
 is config 1 run here. vs_baseline > 1.0 = amp wins.
 
-Methodology notes (this chip sits behind a high-latency shared tunnel):
+Meter (v2, fixes VERDICT r4 weak #1 — the r04 ms-scale rungs were tunnel
+noise):
 
-* One scalar device->host readback (~90 ms) fences N chained async dispatches;
-  timings NEVER ``device_get`` a tensor (a 32 MB fetch through the tunnel costs
-  seconds and poisoned the r03 flash/chip-peak numbers).
-* The chip's effective throughput drifts +-20-30% minute to minute (shared
-  tenancy), so every A-vs-B ratio is the MEDIAN OF PAIRED RATIOS: A and B are
-  timed back-to-back per pair, several pairs per metric.
-* The chip-peak probe runs a dependent-chain matmul loop in ONE dispatch
-  (``lax.fori_loop``) so per-dispatch tunnel latency cannot dilute it.
+* EVERY timed quantity is N steps of a state-carrying ``lax.fori_loop``
+  inside ONE jitted dispatch, fenced by a single 4-byte scalar readback —
+  the ``bench_chip_peak`` pattern applied everywhere. N is calibrated so
+  device work per sample is ~``target_s`` (default 0.8 s), two orders above
+  the tunnel's ~110 +- 10 ms readback jitter. The trip count is a TRACED
+  argument, so calibration never recompiles.
+* Loop carries are arranged so no measured work is loop-invariant (XLA's
+  while-loop LICM hoists anything provably constant): attention chains feed
+  the output back as the next query; optimizer rungs refresh the gradients
+  in-loop from the carried gradient buffer (one elementwise pass) and a
+  separate gen-only loop of exactly that pass is timed and SUBTRACTED from
+  both sides, so ratios compare optimizer work only.
+* Every A-vs-B ratio is the median of per-pair (A_i - gen_i)/(B_i - gen_i)
+  with A/B/gen timed back-to-back per pair (the chip's shared-tenancy drift
+  is minute-scale, +-20-30%).
+* The whole measurement runs TWICE with the same compiled chains; the JSON
+  carries both passes and ``meter.stable`` = every ratio agreeing within
+  +-10% across passes. An unstable bench is flagged, not trusted.
 """
 
 from __future__ import annotations
@@ -26,6 +37,21 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# r04 recorded values for the keys that survive into r05, so round-over-round
+# deltas are readable straight from the bench tail (VERDICT r4 next #1). The
+# r04 ms-scale entries were measured with the noise-prone chained-dispatch
+# meter and are listed for the delta table, not as a trusted baseline.
+R04_RECORDED = {
+    "resnet_o5_mfu": 0.1608, "o5_step_ms": 56.73, "o0_fp32_step_ms": 104.41,
+    "fused_adam_46M_ms": 5.683, "fused_adam_vs_optax": 0.756,
+    "fused_adam_kernel_ms": 5.599, "fused_adam_kernel_vs_optax": 0.76,
+    "fused_adam_o5_ms": 5.77, "fused_adam_o5_vs_optax": 0.98,
+    "flash_attn_s8192_fwd_ms": 15.42, "flash_attn_vs_unfused_fwd": 2.246,
+    "ring_hop_flash_vs_jnp": 1.183, "ring_hop_flash_ms": 6.172,
+    "bert_lamb_step_ms": 51.28, "bert_lamb_mfu": 0.0932,
+    "gpt_o5_step_ms": 30.26, "gpt_o5_mfu": 0.337,
+}
 
 
 def _force(tree):
@@ -40,10 +66,9 @@ _LATENCY = None
 
 
 def _readback_latency() -> float:
-    """The one-scalar device->host round trip (~90 ms via the tunnel). Every
-    _time_once pays it exactly once; without subtracting it a millisecond-
-    scale op reads as latency, and paired RATIOS compress toward 1 —
-    (A+L)/(B+L) != A/B."""
+    """The one-scalar device->host round trip (~110 ms via the tunnel),
+    subtracted from every sample. With >= 0.5 s of device work per sample its
+    +-10 ms jitter is <= 2% — the whole point of the fori_loop meter."""
     global _LATENCY
     if _LATENCY is None:
         f = jax.jit(lambda x: x + 1)
@@ -58,38 +83,95 @@ def _readback_latency() -> float:
     return _LATENCY
 
 
-def _time_once(fn, args, iters):
-    """N chained async dispatches + one scalar readback, already compiled;
-    the readback round trip is measured separately and subtracted."""
-    lat = _readback_latency()
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = fn(*args)
-    _force(out)
-    return max(time.perf_counter() - t0 - lat, 1e-9) / iters
+class Chain:
+    """One measurable unit: a jitted dynamic-trip-count fori_loop over
+    ``step_fn(state, *invariants) -> state``."""
+
+    def __init__(self, step_fn, state, invariants=()):
+        self.state = state
+        self.inv = tuple(invariants)
+
+        @jax.jit
+        def run(n, state, *inv):
+            return jax.lax.fori_loop(0, n, lambda i, s: step_fn(s, *inv), state)
+
+        self.run = run
+        self.n = None
+        self.per_iter_est = None
+
+    def compile(self):
+        out = self.run(jnp.int32(1), self.state, *self.inv)
+        if not np.isfinite(_force(out)):
+            raise RuntimeError("chain produced non-finite state on warmup")
+        return self
+
+    def calibrate(self, target_s=0.8, n_cap=200000):
+        """Pick N so one sample is ~target_s of device work."""
+        lat = _readback_latency()
+        self.compile()
+        n = 4
+        while True:
+            t0 = time.perf_counter()
+            _force(self.run(jnp.int32(n), self.state, *self.inv))
+            t = time.perf_counter() - t0 - lat
+            if t > 0.25 or n >= n_cap:
+                break
+            n = min(n * min(16, max(2, int(0.3 / max(t, 1e-3)))), n_cap)
+        per = max(t / n, 1e-9)
+        self.n = max(1, min(int(target_s / per), n_cap))
+        self.per_iter_est = per
+        return self
+
+    def sample(self) -> float:
+        """One timed sample: per-iteration seconds over self.n loop steps."""
+        lat = _readback_latency()
+        t0 = time.perf_counter()
+        out = self.run(jnp.int32(self.n), self.state, *self.inv)
+        val = _force(out)
+        dt = time.perf_counter() - t0 - lat
+        if not np.isfinite(val):
+            raise RuntimeError("chain state went non-finite during timing")
+        return max(dt, 1e-9) / self.n
+
+    def samples(self, reps=3):
+        return [self.sample() for _ in range(reps)]
 
 
-def _time_it(fn, args, iters=30, reps=3):
-    """Best-of-reps amortized time for one function (compiles first)."""
-    _force(fn(*args))
-    return min(_time_once(fn, args, iters) for _ in range(reps))
-
-
-def _paired_ratio(fn_a, args_a, fn_b, args_b, pairs=8, iters=10):
-    """Median of per-pair (time_a / time_b) with A/B timed back-to-back.
-    Returns (ratio_a_over_b, median_a_seconds, median_b_seconds)."""
-    _force(fn_a(*args_a))
-    _force(fn_b(*args_b))
-    tas, tbs = [], []
+def _round_robin(chains: dict, pairs=3) -> dict:
+    """Time several chains back-to-back per pair (defeats minute-scale chip
+    drift in ratios). Returns name -> [per-iter seconds] * pairs."""
+    out = {k: [] for k in chains}
     for _ in range(pairs):
-        tas.append(_time_once(fn_a, args_a, iters))
-        tbs.append(_time_once(fn_b, args_b, iters))
-    ratios = [ta / tb for ta, tb in zip(tas, tbs)]
-    return float(np.median(ratios)), float(np.median(tas)), float(np.median(tbs))
+        for k, c in chains.items():
+            out[k].append(c.sample())
+    return out
 
 
-def bench_chip_peak(n: int = 16384, loop: int = 10):
+def _sub_ratio(times, a, b, gen_a=None, gen_b=None):
+    """Median over pairs of (a_i - gen_a_i) / (b_i - gen_b_i)."""
+    ratios = []
+    for i in range(len(times[a])):
+        ta = times[a][i] - (times[gen_a][i] if gen_a else 0.0)
+        tb = times[b][i] - (times[gen_b][i] if gen_b else 0.0)
+        if tb > 1e-9:
+            ratios.append(ta / tb)
+    return float(np.median(ratios)) if ratios else float("nan")
+
+
+def _med_sub(times, a, gen=None):
+    vals = [
+        times[a][i] - (times[gen][i] if gen else 0.0)
+        for i in range(len(times[a]))
+    ]
+    return float(np.median(vals))
+
+
+# ---------------------------------------------------------------------------------
+# chip peak
+# ---------------------------------------------------------------------------------
+
+
+def bench_chip_peak(n: int = 16384):
     """Achievable bf16 matmul TFLOP/s: a dependent matmul chain inside one
     jitted fori_loop (one dispatch), scalar-fenced. At n=16384 this reads
     ~165 TFLOP/s on an idle v5e (nominal ~197) — the MFU denominator.
@@ -97,29 +179,29 @@ def bench_chip_peak(n: int = 16384, loop: int = 10):
     a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
     b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
 
-    @jax.jit
-    def mm_loop(a, b):
-        # *0.999 keeps values bounded and defeats loop-invariant hoisting
-        return jax.lax.fori_loop(0, loop, lambda i, o: (a @ o) * 0.999, b)
-
-    dt = _time_it(mm_loop, (a, b), iters=1, reps=2) / loop
+    # 1/sqrt(n) keeps the chained product's magnitude stationary (a random
+    # matmul grows norms by ~sqrt(n) per hop; the old *0.999 overflowed bf16
+    # once the calibrated loop ran hundreds of iterations)
+    mm = Chain(lambda o, a: (a @ o) * (1.0 / 128.0), b, (a,)).calibrate(target_s=1.5)
+    dt = min(mm.samples(3))
     tflops = 2 * n**3 / dt / 1e12
 
     n_el = 192 * 1024 * 1024
     x = jnp.ones((n_el,), jnp.float32)
     y = jnp.ones((n_el,), jnp.float32)
-
-    @jax.jit
-    def triad(x, y):
-        return jax.lax.fori_loop(0, loop, lambda i, y: y * 0.999 + x, y)
-
-    dt = _time_it(triad, (x, y), iters=1, reps=2) / loop
+    triad = Chain(lambda y, x: y * 0.999 + x, y, (x,)).calibrate(target_s=1.5)
+    dt = min(triad.samples(3))
     gbs = 3 * n_el * 4 / dt / 1e9
     return tflops, gbs
 
 
-def bench_resnet50(opt_level: str, batch: int = 128, iters: int = 30) -> float:
-    """Amortized step time (s) for one synthetic ImageNet train step."""
+# ---------------------------------------------------------------------------------
+# ResNet-50 (headline)
+# ---------------------------------------------------------------------------------
+
+
+def make_resnet_rung(opt_level: str, batch: int = 128):
+    """Chain over one synthetic ImageNet train step."""
     import os
     import sys
 
@@ -137,21 +219,23 @@ def bench_resnet50(opt_level: str, batch: int = 128, iters: int = 30) -> float:
     lr = jnp.float32(0.1)
 
     state = (trainer.params, trainer.opt_state, trainer.scaler_state, trainer.bn_state)
-    out = trainer.train_step(*state, images, labels, lr)  # compile
-    _force(out)
 
-    def step(*s):
+    def step(s, images, labels, lr):
         return trainer.train_step(*s, images, labels, lr)[:4]
 
-    return _time_it(step, out[:4], iters=iters, reps=2)
+    return Chain(step, state, (images, labels, lr)).calibrate(target_s=2.0)
 
 
-def bench_flash_attention(S: int = 8192, pairs: int = 4, iters: int = 3):
-    """Pallas flash attention vs the materialized-scores softmax path at long
-    sequence. At S=8192 the unfused path materializes (B*H, S, S) score/prob
-    tensors (~13 GB of HBM traffic/step vs flash's ~0.2 GB) and its backward
-    does not even compile on one chip; the comparison is forward-only.
-    Returns (ratio_unfused_over_flash, flash_s, unfused_s)."""
+# ---------------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------------
+
+
+def make_flash_fwd_rungs(S: int = 8192):
+    """Forward-only chains at long sequence: Pallas flash vs the materialized
+    (B*H, S, S) softmax path (~13 GB of HBM traffic/step vs flash's ~0.2 GB
+    at S=8192; the unfused backward does not even compile there). The output
+    feeds back as the next query — a dependent chain XLA cannot hoist."""
     from beforeholiday_tpu.ops import attention as A
     from beforeholiday_tpu.ops import scaled_upper_triang_masked_softmax
 
@@ -160,49 +244,286 @@ def bench_flash_attention(S: int = 8192, pairs: int = 4, iters: int = 3):
     q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.bfloat16) for kk in ks)
     sc = 1.0 / np.sqrt(D)
 
-    flash = jax.jit(
-        lambda q, k, v: A.flash_attention(q, k, v, causal=True, scale=sc, impl="pallas")
-    )
+    def flash_step(q, k, v):
+        return A.flash_attention(q, k, v, causal=True, scale=sc, impl="pallas")
 
-    def unfused(q, k, v):
+    def unfused_step(q, k, v):
         scores = (q @ k.transpose(0, 1, 3, 2)).reshape(B * H, S, S)
         probs = scaled_upper_triang_masked_softmax(scores, sc)
         return probs.astype(q.dtype).reshape(B, H, S, S) @ v
 
-    ratio, unfused_s, flash_s = _paired_ratio(
-        jax.jit(unfused), (q, k, v), flash, (q, k, v), pairs=pairs, iters=iters
-    )
-    return ratio, flash_s, unfused_s
+    return {
+        "flash": Chain(flash_step, q, (k, v)).calibrate(),
+        "unfused": Chain(unfused_step, q, (k, v)).calibrate(),
+    }
 
 
-def bench_ring_hop(pairs: int = 4, iters: int = 5):
-    """One ring-attention hop (the per-step block compute ring attention
-    repeats cp times): Pallas flash kernel vs the jnp online-softmax hop, at
-    a long-context shard shape. Returns ratio jnp/flash (>1 = flash wins)."""
-    from beforeholiday_tpu.ops.attention import flash_attention_with_lse
+def _fwdbwd_step_of(loss):
+    """Chain step timing the FULL backward: grads wrt q AND k AND v (grad wrt
+    q alone would let XLA dead-code-eliminate the dkv kernel / the unfused
+    dk-dv matmuls), all folded into the carried query so nothing is
+    eliminable. The damped update keeps values bounded over thousands of
+    iterations."""
 
-    BH, Sl, D = 32, 2048, 64
+    def step(q, k, v):
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        upd = dq + 1e-3 * (dk + dv)
+        return jnp.clip(q * 0.999 + upd.astype(q.dtype) * 1e-3, -3, 3)
+
+    return step
+
+
+def make_flash_fwdbwd_rungs(S: int = 4096):
+    """fwd+bwd chains (VERDICT r4 next #8): time the full training-path
+    attention at a length where BOTH backwards compile."""
+    from beforeholiday_tpu.ops import attention as A
+    from beforeholiday_tpu.ops import scaled_upper_triang_masked_softmax
+
+    B, H, D = 2, 16, 64
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, (BH, Sl, D), jnp.bfloat16) for kk in ks)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.bfloat16) for kk in ks)
     sc = 1.0 / np.sqrt(D)
 
-    flash_hop = jax.jit(lambda q, k, v: flash_attention_with_lse(
-        q, k, v, causal=False, scale=sc))
+    def flash_loss(q, k, v):
+        return A.flash_attention(
+            q, k, v, causal=True, scale=sc, impl="pallas"
+        ).astype(jnp.float32).sum()
 
-    @jax.jit
-    def jnp_hop(q, k, v):
+    def unfused_loss(q, k, v):
+        scores = (q @ k.transpose(0, 1, 3, 2)).reshape(B * H, S, S)
+        probs = scaled_upper_triang_masked_softmax(scores, sc)
+        out = probs.astype(q.dtype).reshape(B, H, S, S) @ v
+        return out.astype(jnp.float32).sum()
+
+    return {
+        "flash": Chain(_fwdbwd_step_of(flash_loss), q, (k, v)).calibrate(),
+        "unfused": Chain(_fwdbwd_step_of(unfused_loss), q, (k, v)).calibrate(),
+    }
+
+
+def make_flash_dropout_rungs(S: int = 4096):
+    """Training-path attention WITH attention-probability dropout — the exact
+    configuration the reference's fused kernels exist for (dropout.cuh):
+    in-kernel PRNG flash vs the materialized-scores jnp dropout path,
+    fwd+bwd. r04 had to route any dropout request to the O(S^2) path; this
+    rung prices the fix."""
+    from beforeholiday_tpu.ops import attention as A
+
+    B, H, D = 2, 16, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.bfloat16) for kk in ks)
+    sc = 1.0 / np.sqrt(D)
+    dkey = jax.random.PRNGKey(11)
+
+    def loss_of(impl):
+        def loss(q, k, v):
+            return A.flash_attention(
+                q, k, v, causal=True, scale=sc, impl=impl,
+                dropout_rate=0.1, dropout_key=dkey,
+            ).astype(jnp.float32).sum()
+
+        return loss
+
+    return {
+        "flash": Chain(_fwdbwd_step_of(loss_of("pallas")), q, (k, v)).calibrate(),
+        "unfused": Chain(_fwdbwd_step_of(loss_of("jnp")), q, (k, v)).calibrate(),
+    }
+
+
+def make_ring_hop_rungs(BH: int = 32, Sl: int = 2048):
+    """One ring-attention hop (the per-step block compute ring attention
+    repeats cp times): Pallas flash-with-lse kernel vs the jnp online-softmax
+    hop at a long-context shard shape. The fp32 accumulator output (with a
+    vanishing lse coupling so neither output can be dead-code-eliminated)
+    feeds back as the next query."""
+    from beforeholiday_tpu.ops.attention import flash_attention_with_lse
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (BH, Sl, D), jnp.bfloat16)
+               for kk, D in zip(ks, (64, 64, 64)))
+    sc = 1.0 / np.sqrt(64)
+
+    def flash_step(q, k, v):
+        acc, lse = flash_attention_with_lse(q, k, v, causal=False, scale=sc)
+        return (acc + 1e-30 * lse[..., None]).astype(jnp.bfloat16)
+
+    def jnp_step(q, k, v):
         s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                        k.astype(jnp.float32)) * sc
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.sum(p, axis=-1, keepdims=True)
-        acc = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
-        return acc / l, (m[..., 0] + jnp.log(l[..., 0]))
+        acc = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)) / l
+        lse = m[..., 0] + jnp.log(l[..., 0])
+        return (acc + 1e-30 * lse[..., None]).astype(jnp.bfloat16)
 
-    ratio, _, flash_s = _paired_ratio(
-        jnp_hop, (q, k, v), flash_hop, (q, k, v), pairs=pairs, iters=iters
+    return {
+        "flash": Chain(flash_step, q, (k, v)).calibrate(),
+        "jnp": Chain(jnp_step, q, (k, v)).calibrate(),
+    }
+
+
+# ---------------------------------------------------------------------------------
+# fused Adam rungs (gen-subtraction scheme, see module docstring)
+# ---------------------------------------------------------------------------------
+
+
+def _param_set(key, dtype=jnp.float32):
+    shapes = (
+        [(1024, 1024)] * 12 + [(4096, 1024)] * 3 + [(1024, 4096)] * 3
+        + [(30522, 256)] + [(1024,)] * 48
     )
-    return ratio, flash_s
+    keys = jax.random.split(key, len(shapes))
+    return {f"p{i}": jax.random.normal(k, s, dtype) * 0.02
+            for i, (k, s) in enumerate(zip(keys, shapes))}
+
+
+def _gen_tree(g):
+    """The in-loop gradient refresh: one fused elementwise pass (decay toward
+    a small fixed point so values never drift). Identical work on every side
+    of a comparison AND timed alone for subtraction."""
+    return jax.tree.map(lambda x: x * 0.999 + jnp.asarray(1e-6, x.dtype), g)
+
+
+def make_fused_adam_rungs():
+    """Fused arena-resident Adam vs unfused optax.adamw.
+
+    Rungs (every one a gen-refreshed fori_loop chain; gen loops timed and
+    subtracted so the ratios compare optimizer work only):
+
+    * ``dropin``:  FusedAdam.step_flat with the grad TREE flattened inside the
+      step — what a tree-based training loop pays — vs tree optax.adamw.
+    * ``kernel``:  step_flat on pre-flattened grads — the arena-NATIVE cost
+      (grads born flat via PackedParams; see fused_adam_kernel_ms).
+    * ``o5``:      the shipped amp O5 packed master-weight step
+      (PackedParams + MasterWeights(arena) — one fused kernel pass emits fp32
+      masters AND the bf16 model copy) vs the equivalent optax chain (cast
+      grads up, adamw on masters, cast params back down).
+    """
+    import optax
+    from beforeholiday_tpu.optimizers import FusedAdam, MasterWeights
+    from beforeholiday_tpu.ops.arena import PackedParams, flatten
+
+    hp = dict(lr=1e-3, weight_decay=0.01)
+    opt = optax.adamw(learning_rate=hp["lr"], b1=0.9, b2=0.999, eps=1e-8,
+                      weight_decay=hp["weight_decay"])
+
+    params = _param_set(jax.random.PRNGKey(0))
+    grads = _param_set(jax.random.PRNGKey(1))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+
+    fused = FusedAdam(**hp)
+    pf, _ = flatten(list(params.values()))
+    gf, _ = flatten(list(grads.values()))
+    fstate = fused.init_flat(pf)
+    ost = opt.init(params)
+
+    # --- fp32 drop-in (flatten inside) vs tree optax ---
+    def dropin_step(s):
+        p, st, g = s
+        g = _gen_tree(g)
+        gflat, _ = flatten(list(g.values()))
+        p, st = fused.step_flat(p, gflat, st)
+        return (p, st, g)
+
+    def optax_step(s):
+        p, o, g = s
+        g = _gen_tree(g)
+        updates, o = opt.update(g, o, p)
+        return (optax.apply_updates(p, updates), o, g)
+
+    def gen_tree_only(g):
+        return _gen_tree(g)
+
+    # --- kernel (grads already flat — the arena-native cost) ---
+    def kernel_step(s):
+        p, st, g = s
+        g = g * 0.999 + 1e-6
+        p, st = fused.step_flat(p, g, st)
+        return (p, st, g)
+
+    def gen_flat_only(g):
+        return g * 0.999 + 1e-6
+
+    # --- shipped O5: PackedParams master-weights vs optax chain ---
+    model_tree = _param_set(jax.random.PRNGKey(0), jnp.bfloat16)
+    g_bf_tree = _param_set(jax.random.PRNGKey(1), jnp.bfloat16)
+    pk_model = PackedParams.pack(model_tree)
+    pk_grads = PackedParams.pack(g_bf_tree)
+    mw = MasterWeights(FusedAdam(**hp), arena=True)
+    mw_state = mw.init(pk_model)
+    fi = jnp.float32(0.0)
+    inv_scale = 1.0 / 65536
+
+    def gen_packed(g):
+        return g.replace_arenas(
+            [a * 0.999 + jnp.asarray(1e-6, a.dtype) for a in g.arenas]
+        )
+
+    def mw_step(s):
+        pk, st, g = s
+        g = gen_packed(g)
+        pk, st = mw.step(pk, g, st, found_inf=fi, grad_scale=inv_scale)
+        return (pk, st, g)
+
+    master32 = _param_set(jax.random.PRNGKey(0))
+    ost5 = opt.init(master32)
+    modelp0 = jax.tree.map(lambda p: p.astype(jnp.bfloat16), master32)
+
+    def optax_o5_step(s):
+        master, o, modelp, g = s
+        g = _gen_tree(g)
+        g32 = jax.tree.map(lambda x: x.astype(jnp.float32) * inv_scale, g)
+        updates, o = opt.update(g32, o, master)
+        master = optax.apply_updates(master, updates)
+        modelp = jax.tree.map(lambda p: p.astype(jnp.bfloat16), master)
+        return (master, o, modelp, g)
+
+    def gen16_only(g):
+        return _gen_tree(g)
+
+    target = 0.6
+    chains = {
+        "gen_tree": Chain(gen_tree_only, grads).calibrate(target),
+        "optax": Chain(optax_step, (params, ost, grads)).calibrate(target),
+        "dropin": Chain(dropin_step, (pf, fstate, grads)).calibrate(target),
+        "gen_flat": Chain(gen_flat_only, gf).calibrate(target),
+        "kernel": Chain(kernel_step, (pf, fstate, gf)).calibrate(target),
+        "gen16": Chain(gen16_only, g_bf_tree).calibrate(target),
+        # the o5 chain refreshes PACKED grads (one bf16 arena pass) — its
+        # subtraction baseline must be that same pass, not the 67-leaf tree
+        # refresh (single- vs multi-buffer streaming differ ~2x on this chip)
+        "gen_pack": Chain(gen_packed, pk_grads).calibrate(target),
+        "o5": Chain(mw_step, (pk_model, mw_state, pk_grads)).calibrate(target),
+        "optax_o5": Chain(
+            optax_o5_step, (master32, ost5, modelp0, g_bf_tree)
+        ).calibrate(target),
+    }
+    return chains, n_params
+
+
+def measure_fused_adam(chains, pairs=3):
+    t = _round_robin(chains, pairs=pairs)
+    return {
+        # the SHIPPED path (amp arena_native: grads born flat) vs tree optax —
+        # r04's "fused_adam_kernel_*"
+        "fused_adam_native_ms": _med_sub(t, "kernel", "gen_flat") * 1e3,
+        "fused_adam_native_vs_optax": _sub_ratio(t, "optax", "kernel", "gen_tree", "gen_flat"),
+        # legacy tree-grads step_flat interface (flattens in-step) — r04's
+        # "fused_adam_46M_ms"/"fused_adam_vs_optax"; loses by design, the
+        # concat pack costs ~2 ms at 46M — that is WHY arena_native exists
+        "fused_adam_treeapi_ms": _med_sub(t, "dropin", "gen_tree") * 1e3,
+        "fused_adam_treeapi_vs_optax": _sub_ratio(t, "optax", "dropin", "gen_tree", "gen_tree"),
+        # shipped amp O5 packed master-weights step vs the optax O5 chain;
+        # each side subtracts ITS OWN grad-refresh baseline
+        "fused_adam_o5_ms": _med_sub(t, "o5", "gen_pack") * 1e3,
+        "fused_adam_o5_vs_optax": _sub_ratio(t, "optax_o5", "o5", "gen16", "gen_pack"),
+    }
+
+
+# ---------------------------------------------------------------------------------
+# model rungs: BERT + LAMB, GPT O5
+# ---------------------------------------------------------------------------------
 
 
 def _first_candidate(candidates, run_one, label):
@@ -221,71 +542,87 @@ def _first_candidate(candidates, run_one, label):
     return None, "all_failed"
 
 
-def bench_bert_lamb(iters: int = 5):
+def make_bert_rung():
     """BERT + FusedLAMB pretraining step (BASELINE config 4; ref:
     apex/transformer/testing/standalone_bert.py:255 + DistributedFusedLAMB's
-    MLPerf recipe). Geometries tried largest-first under the tunnel's
-    ~1 GB compile-payload limit. Returns ((step_seconds, flops_per_step), tag)."""
+    MLPerf recipe) on the shipped fast path: bf16 model via amp O5,
+    arena-NATIVE PackedParams masters, LAMB step_flat with born-flat grads,
+    flash attention engaged, batch raised to the HBM-bound regime (VERDICT
+    r4 next #5 — r04 timed the list-path step at a toy batch 8).
+    Returns ((chain, flops_per_step), tag)."""
+    from beforeholiday_tpu import amp
     from beforeholiday_tpu.optimizers import FusedLAMB
     from beforeholiday_tpu.testing import bert
 
     candidates = [
-        ("bert_large_8layer", bert.bert_large(seq_len=128, n_layers=8,
-                                              dtype=jnp.bfloat16)),
-        ("bert_large_4layer", bert.bert_large(seq_len=128, n_layers=4,
-                                              dtype=jnp.bfloat16)),
-        ("bert_512x8_4layer", bert.BertConfig(
+        ("bert_large_8layer_b64", (bert.bert_large(
+            seq_len=128, n_layers=8, dtype=jnp.bfloat16), 64)),
+        ("bert_large_8layer_b32", (bert.bert_large(
+            seq_len=128, n_layers=8, dtype=jnp.bfloat16), 32)),
+        ("bert_large_4layer_b64", (bert.bert_large(
+            seq_len=128, n_layers=4, dtype=jnp.bfloat16), 64)),
+        ("bert_512x8_4layer_b64", (bert.BertConfig(
             vocab_size=30522, seq_len=128, d_model=512, n_heads=8, n_layers=4,
-            dtype=jnp.bfloat16)),
-        ("bert_256x4_2layer", bert.BertConfig(
-            vocab_size=8192, seq_len=128, d_model=256, n_heads=4, n_layers=2,
-            dtype=jnp.bfloat16)),
+            dtype=jnp.bfloat16), 64)),
     ]
-    batch = 8
 
-    def run_one(cfg):
+    def run_one(cfg_batch):
+        cfg, batch = cfg_batch
         params = bert.init(jax.random.PRNGKey(0), cfg)
         batch_data = bert.synthetic_batch(jax.random.PRNGKey(1), cfg, batch)
-        opt = FusedLAMB(lr=1e-3, weight_decay=0.01)
-        state = opt.init(params)
+        m = amp.initialize(
+            lambda p, tok: bert.forward(p, tok, cfg), params,
+            FusedLAMB(lr=1e-3, weight_decay=0.01), "O5", arena_native=True,
+        )
 
-        @jax.jit
-        def step(p, s):
-            loss, g = jax.value_and_grad(bert.pretrain_loss)(p, *batch_data, cfg)
-            p, s = opt.step(p, g, s)
-            return p, s, loss
+        def loss(pk):
+            return bert.pretrain_loss(pk.unpack(), *batch_data, cfg)
+
+        opt_state = m.optimizer.init(m.params)
+
+        def step(s):
+            pk, o = s
+            _, g = jax.value_and_grad(loss)(pk)
+            pk, o = m.optimizer.step(pk, g, o)
+            return (pk, o)
 
         n_params = sum(x.size for x in jax.tree.leaves(params))
-        t = _time_it(lambda p, s: step(p, s), (params, state), iters=iters, reps=2)
-        return t, 6.0 * n_params * batch * cfg.seq_len
+        chain = Chain(step, (m.params, opt_state)).calibrate(target_s=1.5)
+        return chain, 6.0 * n_params * batch * cfg.seq_len
 
     return _first_candidate(candidates, run_one, "bert")
 
 
-def bench_gpt_train(iters: int = 10):
+def make_gpt_rung():
     """Flagship GPT training step (BASELINE config 5 shape): amp O5 with
-    ARENA-RESIDENT fp32 masters + flash attention + FusedAdam, single chip.
-    Returns ((step_s, tokens, flops_per_step), tag)."""
+    arena-NATIVE PackedParams (fp32 masters + model copy in one kernel pass,
+    grads born flat) + flash attention + FusedAdam, single chip. Batch
+    pushed toward the HBM limit (VERDICT r4 next #7).
+    Returns ((chain, tokens, flops_per_step), tag)."""
     from beforeholiday_tpu import amp
     from beforeholiday_tpu.optimizers import FusedAdam
     from beforeholiday_tpu.testing import gpt
 
+    big = gpt.GPTConfig(
+        vocab_size=32000, seq_len=1024, d_model=512, n_heads=8, n_layers=6,
+        dtype=jnp.bfloat16)
+    small = gpt.GPTConfig(
+        vocab_size=8192, seq_len=512, d_model=256, n_heads=4, n_layers=4,
+        dtype=jnp.bfloat16)
     candidates = [
-        ("gpt_512x8_6layer_s1024", gpt.GPTConfig(
-            vocab_size=32000, seq_len=1024, d_model=512, n_heads=8, n_layers=6,
-            dtype=jnp.bfloat16)),
-        ("gpt_256x4_4layer_s512", gpt.GPTConfig(
-            vocab_size=8192, seq_len=512, d_model=256, n_heads=4, n_layers=4,
-            dtype=jnp.bfloat16)),
+        ("gpt_512x8_6layer_s1024_b32", (big, 32)),
+        ("gpt_512x8_6layer_s1024_b16", (big, 16)),
+        ("gpt_512x8_6layer_s1024_b8", (big, 8)),
+        ("gpt_256x4_4layer_s512_b8", (small, 8)),
     ]
-    batch = 8
 
-    def run_one(cfg):
+    def run_one(cfg_batch):
+        cfg, batch = cfg_batch
         params = gpt.init(jax.random.PRNGKey(0), cfg)
         tokens, targets = gpt.synthetic_batch(jax.random.PRNGKey(1), cfg, batch)
         m = amp.initialize(
             lambda p, t: gpt.forward(p, t, cfg), params,
-            FusedAdam(lr=1e-4), "O5", arena_masters=True,
+            FusedAdam(lr=1e-4), "O5", arena_native=True,
         )
 
         def loss_fn(p, tok, tgt):
@@ -295,133 +632,33 @@ def bench_gpt_train(iters: int = 10):
         opt_state = m.optimizer.init(m.params)
         sstate = m.scaler.init()
 
-        @jax.jit
-        def step(p, o, s):
-            loss, g, fi, s = svag(p, s, tokens, targets)
+        def step(s, tokens, targets):
+            p, o, sc = s
+            loss, g, fi, sc = svag(p, sc, tokens, targets)
             p, o = m.optimizer.step(p, g, o, found_inf=fi)
-            return p, o, s, loss
+            return (p, o, sc)
 
         n_params = sum(x.size for x in jax.tree.leaves(params))
-        t = _time_it(lambda p, o, s: step(p, o, s),
-                     (m.params, opt_state, sstate), iters=iters, reps=2)
-        return t, batch * cfg.seq_len, 6.0 * n_params * batch * cfg.seq_len
+        chain = Chain(
+            step, (m.params, opt_state, sstate), (tokens, targets)
+        ).calibrate(target_s=1.5)
+        return chain, batch * cfg.seq_len, 6.0 * n_params * batch * cfg.seq_len
 
-    res, tag = _first_candidate(candidates, run_one, "gpt")
-    if res is None:
-        return None, tag
-    return res, tag
+    return _first_candidate(candidates, run_one, "gpt")
 
 
-def bench_fused_adam(pairs: int = 8, iters: int = 10):
-    """Fused arena-resident Adam vs unfused optax.adamw, paired.
-
-    Two comparisons, both reflecting shipped code paths:
-
-    * fp32 optimizer step, state in each side's native layout — FusedAdam with
-      arena-resident state + pre-flattened grads (what the arena-masters amp
-      path delivers) vs optax.adamw over the param tree.
-    * the realistic amp O2/O5 master-weight step — MasterWeights(FusedAdam,
-      arena=True) on a bf16 model (one fused kernel pass emits fp32 masters
-      AND the bf16 model copy) vs the equivalent optax chain (cast grads,
-      adamw on fp32 masters, cast params back to bf16).
-    """
-    import optax
-    from beforeholiday_tpu.optimizers import FusedAdam, MasterWeights
-    from beforeholiday_tpu.ops.arena import flatten
-
-    def _param_set(key, dtype=jnp.float32):
-        shapes = (
-            [(1024, 1024)] * 12 + [(4096, 1024)] * 3 + [(1024, 4096)] * 3
-            + [(30522, 256)] + [(1024,)] * 48
-        )
-        keys = jax.random.split(key, len(shapes))
-        return {f"p{i}": jax.random.normal(k, s, dtype) * 0.02
-                for i, (k, s) in enumerate(zip(keys, shapes))}
-
-    hp = dict(lr=1e-3, weight_decay=0.01)
-    opt = optax.adamw(learning_rate=hp["lr"], b1=0.9, b2=0.999, eps=1e-8,
-                      weight_decay=hp["weight_decay"])
-
-    # --- fp32: arena-resident fused vs tree optax ---
-    # The drop-in rung flattens the grad tree INSIDE the timed step — that is
-    # what the shipped arena path (MasterWeights._step_arena) pays per step.
-    # The kernel-only rung times pre-flattened grads: the cost floor a
-    # flat-gradient training loop would see, labeled separately.
-    params = _param_set(jax.random.PRNGKey(0))
-    grads = _param_set(jax.random.PRNGKey(1))
-    pf, _ = flatten(list(params.values()))
-    gf, _ = flatten(list(grads.values()))
-    fused = FusedAdam(**hp)
-    fstate = fused.init_flat(pf)
-
-    @jax.jit
-    def fused_step(p, gtree, s):
-        gflat, _ = flatten(list(gtree.values()))
-        return fused.step_flat(p, gflat, s)
-
-    fused_kernel_step = jax.jit(lambda p, g, s: fused.step_flat(p, g, s))
-
-    ost = opt.init(params)
-
-    @jax.jit
-    def optax_step(g, p, o):
-        updates, o = opt.update(g, o, p)
-        return optax.apply_updates(p, updates), o
-
-    r32, optax_s, fused_s = _paired_ratio(
-        optax_step, (grads, params, ost), fused_step, (pf, grads, fstate),
-        pairs=pairs, iters=iters,
-    )
-    rk, _, kernel_s = _paired_ratio(
-        optax_step, (grads, params, ost), fused_kernel_step, (pf, gf, fstate),
-        pairs=max(pairs // 2, 3), iters=iters,
-    )
-
-    # --- O5 master-weights step on a bf16 model ---
-    model = _param_set(jax.random.PRNGKey(0), jnp.bfloat16)
-    g_bf = _param_set(jax.random.PRNGKey(1), jnp.bfloat16)
-    mw = MasterWeights(FusedAdam(**hp), arena=True)
-    mw_state = mw.init(model)
-    fi = jnp.float32(0.0)
-    inv_scale = 1.0 / 65536
-    mw_step = jax.jit(lambda p, g, s: mw.step(p, g, s, found_inf=fi,
-                                              grad_scale=inv_scale))
-
-    master32 = _param_set(jax.random.PRNGKey(0))
-    ost5 = opt.init(master32)
-
-    @jax.jit
-    def optax_o5(g_bf, master, o):
-        g32 = jax.tree.map(lambda g: g.astype(jnp.float32) * inv_scale, g_bf)
-        updates, o = opt.update(g32, o, master)
-        master = optax.apply_updates(master, updates)
-        modelp = jax.tree.map(lambda p: p.astype(jnp.bfloat16), master)
-        return master, o, modelp
-
-    r5, _, o5_s = _paired_ratio(
-        optax_o5, (g_bf, master32, ost5), mw_step, (model, g_bf, mw_state),
-        pairs=pairs, iters=iters,
-    )
-    n_params = sum(x.size for x in jax.tree.leaves(params))
-    return dict(
-        n_params=n_params,
-        fused_adam_ms=fused_s * 1e3,
-        optax_ms=optax_s * 1e3,
-        fused_adam_vs_optax=r32,
-        fused_adam_kernel_ms=kernel_s * 1e3,
-        fused_adam_kernel_vs_optax=rk,
-        fused_adam_o5_ms=o5_s * 1e3,
-        fused_adam_o5_vs_optax=r5,
-    )
+# ---------------------------------------------------------------------------------
+# pipeline overhead (CPU-mesh proxy)
+# ---------------------------------------------------------------------------------
 
 
 def bench_pp_overhead():
     """1F1B schedule overhead vs sequential grad accumulation, measured on a
-    virtual 8-CPU mesh in a subprocess (the chip behind the tunnel is a
-    single device; the schedule tax — bubbles + backward recompute — is a
-    total-work property the CPU mesh exposes fine). The child env scrubs the
-    axon vars: the sitecustomize otherwise force-registers the TPU backend
-    and the 'CPU mesh' silently becomes one device."""
+    virtual 8-CPU mesh in a subprocess — a SCHEDULE-LOGIC PROXY, not a TPU
+    number (ICI ring latency and bf16 compute ratios differ; the chip behind
+    the tunnel is a single device). The child env scrubs the axon vars: the
+    sitecustomize otherwise force-registers the TPU backend and the 'CPU
+    mesh' silently becomes one device."""
     import os
     import subprocess
     import sys
@@ -441,19 +678,35 @@ def bench_pp_overhead():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+# ---------------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------------
+
+
 def _stage(detail, fn, *args):
     """Run one bench stage, folding failures into the detail dict instead of
     killing the whole bench (the tunnel's compile limits are flaky)."""
     try:
         return fn(*args)
     except Exception as e:
-        detail[f"{fn.__name__}_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+        detail[f"{fn.__name__}_error"] = f"{type(e).__name__}: {str(e)[:160]}"
         return None
+
+
+def _free(*_):
+    """Named-reference sink: callers assign their rung vars to None and call
+    this; gc then lets the chip free the buffers (BERT-large b64 + masters
+    holds ~2.5 GB — without this the GPT rung OOMs on a 16 GB chip)."""
+    import gc
+
+    gc.collect()
 
 
 def main():
     batch = 128
     detail = {"backend": jax.default_backend(), "global_batch": batch}
+    # ratio/one-number keys measured twice for the stability gate
+    pass2 = {}
 
     peak = _stage(detail, bench_chip_peak)
     peak_tflops = None
@@ -461,73 +714,167 @@ def main():
         peak_tflops, hbm_gbs = peak
         detail["chip_peak_bf16_tflops"] = round(peak_tflops, 1)
         detail["chip_hbm_gbs"] = round(hbm_gbs, 0)
+    else:
+        # MFU numbers must not silently vanish with a flaky peak probe; fall
+        # back to the r04 measured peak, loudly labeled
+        peak_tflops = 172.6
+        detail["chip_peak_note"] = "probe failed; MFU uses r04 peak 172.6"
 
     def mfu(model_flops, dt):
         if not (peak_tflops and dt):
             return None
         return round(model_flops / dt / 1e12 / peak_tflops, 4)
 
-    o5_s = _stage(detail, bench_resnet50, "O5", batch)
-    o0_s = _stage(detail, bench_resnet50, "O0", batch)
-    if o5_s:
-        detail["o5_step_ms"] = round(o5_s * 1e3, 2)
-    if o0_s:
-        detail["o0_fp32_step_ms"] = round(o0_s * 1e3, 2)
-        detail["o0_img_per_s"] = round(batch / o0_s, 1)
-    if o5_s:
-        # ResNet-50 fwd+bwd ~ 3x 4.1 GFLOP/img
-        rn_flops = 3 * 4.1e9 * batch
-        detail["resnet_o5_model_tflops"] = round(rn_flops / o5_s / 1e12, 2)
-        m = mfu(rn_flops, o5_s)
-        if m:
-            detail["resnet_o5_mfu"] = m
+    # Rung order is memory-aware: the big-model rungs (GPT at batch 32 peaks
+    # ~12 GB transient; BERT-large b64 holds ~2 GB of state) run FIRST on a
+    # clean chip, and EVERY rung's arrays are dropped before the next — an
+    # OOM on this backend can poison the tunnel session for every stage
+    # after it, so ordering is correctness, not tidiness.
 
-    adam = _stage(detail, bench_fused_adam)
-    if adam:
-        detail["fused_adam_46M_ms"] = round(adam["fused_adam_ms"], 3)
-        detail["fused_adam_vs_optax"] = round(adam["fused_adam_vs_optax"], 3)
-        detail["fused_adam_kernel_ms"] = round(adam["fused_adam_kernel_ms"], 3)
-        detail["fused_adam_kernel_vs_optax"] = round(adam["fused_adam_kernel_vs_optax"], 3)
-        detail["fused_adam_o5_ms"] = round(adam["fused_adam_o5_ms"], 3)
-        detail["fused_adam_o5_vs_optax"] = round(adam["fused_adam_o5_vs_optax"], 3)
-
-    attn = _stage(detail, bench_flash_attention)
-    if attn:
-        ratio, flash_s, unfused_s = attn
-        detail["flash_attn_s8192_fwd_ms"] = round(flash_s * 1e3, 2)
-        detail["flash_attn_vs_unfused_fwd"] = round(ratio, 3)
-        detail["flash_attn_note"] = (
-            "unfused bwd uncompilable at S=8192; flash bwd runs"
-        )
-
-    ring = _stage(detail, bench_ring_hop)
-    if ring:
-        detail["ring_hop_flash_vs_jnp"] = round(ring[0], 3)
-        detail["ring_hop_flash_ms"] = round(ring[1] * 1e3, 3)
-
-    bert_res = _stage(detail, bench_bert_lamb)
-    if bert_res and bert_res[0]:
-        (t, flops), tag = bert_res
-        detail["bert_lamb_step_ms"] = round(t * 1e3, 2)
-        detail["bert_lamb_config"] = tag
-        m = mfu(flops, t)
-        if m:
-            detail["bert_lamb_mfu"] = m
-
-    pp_res = _stage(detail, bench_pp_overhead)
-    if pp_res:
-        detail["pp_overhead_vs_sequential"] = pp_res["pp_overhead_vs_sequential"]
-        detail["pp_1f1b_ms_cpu8"] = pp_res["pp_1f1b_ms"]
-
-    gpt_res = _stage(detail, bench_gpt_train)
+    # --- GPT flagship (arena-native O5) ---
+    gpt_res = _stage(detail, make_gpt_rung)
     if gpt_res and gpt_res[0]:
-        (t, tokens, flops), tag = gpt_res
+        (chain, tokens, flops), tag = gpt_res
+        t = min(chain.samples(3))
+        pass2["gpt_o5_step_ms"] = min(chain.samples(2)) * 1e3
         detail["gpt_o5_step_ms"] = round(t * 1e3, 2)
         detail["gpt_o5_tokens_per_s"] = round(tokens / t, 1)
         detail["gpt_config"] = tag
         m = mfu(flops, t)
         if m:
             detail["gpt_o5_mfu"] = m
+        chain = None
+    gpt_res = None
+    _free()
+
+    # --- BERT + LAMB (arena-native O5, step_flat, batch >= 64) ---
+    bert_res = _stage(detail, make_bert_rung)
+    if bert_res and bert_res[0]:
+        (chain, flops), tag = bert_res
+        t = min(chain.samples(3))
+        pass2["bert_lamb_step_ms"] = min(chain.samples(2)) * 1e3
+        detail["bert_lamb_step_ms"] = round(t * 1e3, 2)
+        detail["bert_lamb_config"] = tag
+        m = mfu(flops, t)
+        if m:
+            detail["bert_lamb_mfu"] = m
+        chain = None
+    bert_res = None
+    _free()
+
+    # --- ResNet headline ---
+    o5 = _stage(detail, make_resnet_rung, "O5", batch)
+    o5_s = o0_s = None
+    if o5:
+        o5_s = min(o5.samples(3))
+        pass2["o5_step_ms"] = min(o5.samples(2)) * 1e3
+        detail["o5_step_ms"] = round(o5_s * 1e3, 2)
+        rn_flops = 3 * 4.1e9 * batch  # fwd+bwd ~ 3x 4.1 GFLOP/img
+        detail["resnet_o5_model_tflops"] = round(rn_flops / o5_s / 1e12, 2)
+        m = mfu(rn_flops, o5_s)
+        if m:
+            detail["resnet_o5_mfu"] = m
+    o5 = None
+    _free()
+    o0 = _stage(detail, make_resnet_rung, "O0", batch)
+    if o0:
+        o0_s = min(o0.samples(3))
+        detail["o0_fp32_step_ms"] = round(o0_s * 1e3, 2)
+        detail["o0_img_per_s"] = round(batch / o0_s, 1)
+    o0 = None
+    _free()
+
+    # --- fused Adam family ---
+    adam = _stage(detail, make_fused_adam_rungs)
+    if adam:
+        chains, n_params = adam
+        r1 = measure_fused_adam(chains)
+        r2 = measure_fused_adam(chains)
+        for k, val in r1.items():
+            detail[k] = round(val, 3)
+        detail["fused_adam_n_params"] = n_params
+        pass2.update(r2)
+        detail["fused_adam_note"] = (
+            "gen-subtracted fori_loop meter; native = shipped arena_native "
+            "path (grads born flat, maps to r04 fused_adam_kernel_*); "
+            "treeapi = legacy tree-grads interface incl. in-step pack (maps "
+            "to r04 fused_adam_vs_optax); single-buffer streaming caps at "
+            "~670 GB/s on this chip (7-pass floor 1.95 ms), multi-buffer "
+            "concurrency takes the fused step below it"
+        )
+        chains = None
+    adam = None
+    _free()
+
+    # --- flash attention family ---
+    fa = _stage(detail, make_flash_fwd_rungs)
+    if fa:
+        t1 = _round_robin(fa, pairs=3)
+        t2 = _round_robin(fa, pairs=2)
+        detail["flash_attn_s8192_fwd_ms"] = round(_med_sub(t1, "flash") * 1e3, 2)
+        detail["flash_attn_vs_unfused_fwd"] = round(_sub_ratio(t1, "unfused", "flash"), 3)
+        pass2["flash_attn_vs_unfused_fwd"] = _sub_ratio(t2, "unfused", "flash")
+        detail["flash_attn_note"] = (
+            "unfused bwd uncompilable at S=8192; fwd+bwd compared at S=4096"
+        )
+    fa = None
+    _free()
+
+    fab = _stage(detail, make_flash_fwdbwd_rungs)
+    if fab:
+        t1 = _round_robin(fab, pairs=3)
+        t2 = _round_robin(fab, pairs=2)
+        detail["flash_attn_s4096_fwdbwd_ms"] = round(_med_sub(t1, "flash") * 1e3, 2)
+        detail["flash_attn_fwdbwd_vs_unfused"] = round(
+            _sub_ratio(t1, "unfused", "flash"), 3)
+        pass2["flash_attn_fwdbwd_vs_unfused"] = _sub_ratio(t2, "unfused", "flash")
+    fab = None
+    _free()
+
+    fdr = _stage(detail, make_flash_dropout_rungs)
+    if fdr:
+        t1 = _round_robin(fdr, pairs=3)
+        t2 = _round_robin(fdr, pairs=2)
+        detail["flash_dropout_s4096_fwdbwd_ms"] = round(
+            _med_sub(t1, "flash") * 1e3, 2)
+        detail["flash_dropout_vs_unfused"] = round(
+            _sub_ratio(t1, "unfused", "flash"), 3)
+        pass2["flash_dropout_vs_unfused"] = _sub_ratio(t2, "unfused", "flash")
+    fdr = None
+    _free()
+
+    # --- ring hop ---
+    ring = _stage(detail, make_ring_hop_rungs)
+    if ring:
+        t1 = _round_robin(ring, pairs=3)
+        t2 = _round_robin(ring, pairs=2)
+        detail["ring_hop_flash_ms"] = round(_med_sub(t1, "flash") * 1e3, 3)
+        detail["ring_hop_flash_vs_jnp"] = round(_sub_ratio(t1, "jnp", "flash"), 3)
+        pass2["ring_hop_flash_vs_jnp"] = _sub_ratio(t2, "jnp", "flash")
+    ring = None
+    _free()
+
+    # --- PP overhead (CPU proxy, subprocess) ---
+    pp_res = _stage(detail, bench_pp_overhead)
+    if pp_res:
+        detail["pp_overhead_vs_sequential_cpu8proxy"] = pp_res[
+            "pp_overhead_vs_sequential"]
+        detail["pp_1f1b_ms_cpu8"] = pp_res["pp_1f1b_ms"]
+        detail["pp_note"] = "schedule-logic proxy on an 8-CPU mesh, not a TPU number"
+
+    # --- stability gate: pass-2 must agree within 10% on every ratio ---
+    unstable = []
+    for k, v2 in pass2.items():
+        v1 = detail.get(k)
+        if v1 and np.isfinite(v2) and abs(v2 - v1) > 0.10 * abs(v1):
+            unstable.append(k)
+    detail["meter"] = {
+        "method": "fori_loop-chained, gen-subtracted, paired; two passes",
+        "stable": not unstable,
+        "unstable_keys": unstable,
+        "pass2": {k: round(float(v), 3) for k, v in pass2.items()},
+    }
+    detail["r04_recorded"] = R04_RECORDED
 
     print(json.dumps({
         "metric": "resnet50_amp_O5_train",

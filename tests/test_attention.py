@@ -150,3 +150,53 @@ class TestSelfAttention:
         ctx = _ref_attn(hs(q), hs(k), hs(v), True, 1.0 / np.sqrt(D // H))
         want = ctx.transpose(0, 2, 1, 3).reshape(B, S, D) @ w_out
         np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-5)
+
+
+class TestDropoutDispatch:
+    """CPU-side dispatch contract for in-kernel dropout. The kernel itself
+    needs the hardware PRNG (no interpret-mode lowering), so its numerics —
+    determinism, variance law, same-mask gradient parity, S=8192 fwd+bwd —
+    are verified on a real chip by ``testing/tpu_checks.py`` (all-PASS r5)."""
+
+    def test_dropout_falls_back_to_jnp_off_tpu(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0), S=128)
+        auto = A.flash_attention(
+            q, k, v, dropout_rate=0.25, dropout_key=jax.random.PRNGKey(1))
+        ref = A.flash_attention(
+            q, k, v, impl="jnp", dropout_rate=0.25,
+            dropout_key=jax.random.PRNGKey(1))
+        np.testing.assert_array_equal(np.asarray(auto), np.asarray(ref))
+
+    def test_forced_pallas_dropout_raises_off_tpu(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0), S=128)
+        with pytest.raises(ValueError, match="real TPU"):
+            A.flash_attention(q, k, v, impl="pallas", dropout_rate=0.25,
+                              dropout_key=jax.random.PRNGKey(1))
+
+    def test_dropout_requires_key(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0), S=128)
+        with pytest.raises(ValueError, match="dropout_key"):
+            A.flash_attention(q, k, v, dropout_rate=0.25)
+
+    def test_jnp_dropout_statistics(self):
+        """Inverted-scaling contract on the oracle path: mean preserved,
+        variance follows (rate/keep) * sum p^2."""
+        B, H, S, D = 2, 2, 128, 32
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q, k, _ = (jax.random.normal(kk, (B, H, S, D)) for kk in ks)
+        out = A.flash_attention(
+            q, k, jnp.ones((B, H, S, D)), impl="jnp",
+            dropout_rate=0.25, dropout_key=jax.random.PRNGKey(7))
+        arr = np.asarray(out, np.float64)
+        assert abs(arr.mean() - 1.0) < 0.02, arr.mean()
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (1.0 / np.sqrt(D))
+        p = jax.nn.softmax(s, axis=-1)
+        pred = (0.25 / 0.75) * float(jnp.mean(jnp.sum(p * p, axis=-1)))
+        assert 0.5 < arr.var() / pred < 2.0, (arr.var(), pred)
+
+    def test_rate0_identical_to_plain(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0), S=128)
+        plain = A.flash_attention(q, k, v, causal=True)
+        rate0 = A.flash_attention(q, k, v, causal=True, dropout_rate=0.0,
+                                  dropout_key=jax.random.PRNGKey(1))
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(rate0))
