@@ -13,6 +13,7 @@ from beforeholiday_tpu.optimizers.fused import (  # noqa: F401
     FusedMixedPrecisionLamb,
     FusedNovoGrad,
     FusedSGD,
+    supports_flat_step,
 )
 
 __all__ = [
@@ -23,6 +24,7 @@ __all__ = [
     "FusedLAMB",
     "FusedLARS",
     "FusedMixedPrecisionLamb",
+    "supports_flat_step",
     "FusedNovoGrad",
     "FusedSGD",
 ]

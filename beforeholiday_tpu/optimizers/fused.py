@@ -610,6 +610,19 @@ class FusedLARS(_FusedOptimizer):
         return unflat(new_p), {"momentum_buffer": unflat(new_b), "step": step_no}
 
 
+def supports_flat_step(opt) -> bool:
+    """True when ``opt`` can run the arena-resident flat path: it overrides
+    ``step_flat`` AND carries no per-leaf decay mask (the flat path applies
+    one weight decay to the whole arena). THE eligibility predicate for
+    ``amp.initialize(arena_native=True)`` auto-enablement — callers must not
+    re-derive it (the rule has two clauses and they drift)."""
+    return (
+        isinstance(opt, _FusedOptimizer)
+        and type(opt).step_flat is not _FusedOptimizer.step_flat
+        and opt.no_weight_decay_mask is None
+    )
+
+
 class MasterWeights:
     """fp32 master-weight optimizer wrapper (ref: apex/amp/_process_optimizer.py:321-489).
 

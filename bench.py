@@ -53,6 +53,21 @@ R04_RECORDED = {
     "gpt_o5_step_ms": 30.26, "gpt_o5_mfu": 0.337,
 }
 
+# ONE-OFF r5 decomposition of the ResNet-50 O5 step (b128, paired fori_loop
+# probes, 2026-07-30 on the build chip) — a dated RECORD like R04_RECORDED,
+# not something this meter re-measures each run. Device-side XProf is
+# unavailable through the tunnel (host-only trace), so the attribution came
+# from paired sub-step chains.
+R05_RESNET_ANALYSIS = (
+    "fwd 15 ms of which BN stats ~6 (convs ~32% MFU, stem conv1 81 TFLOP/s "
+    "so no small-channel pathology), bwd ~35 ms (conv dgrad/wgrad at ~18% "
+    "MFU - the hard bound, XLA's conv backward lowering), optimizer+scaler "
+    "~7 ms. r5 fixes: arena-native optimizer step + one-pass-shifted BN "
+    "stats (~5-7 ms combined); batch 256/512 gave no further throughput "
+    "(not batch-starved). Remaining gap to the 2600 img/s north star is "
+    "conv backward efficiency, outside framework control under XLA."
+)
+
 
 def _force(tree):
     """Fence device execution: reduce ONE leaf to a scalar on device and fetch
@@ -774,6 +789,7 @@ def main():
         m = mfu(rn_flops, o5_s)
         if m:
             detail["resnet_o5_mfu"] = m
+        detail["resnet_analysis_r5_recorded"] = R05_RESNET_ANALYSIS
     o5 = None
     _free()
     o0 = _stage(detail, make_resnet_rung, "O0", batch)

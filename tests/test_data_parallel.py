@@ -173,6 +173,67 @@ class TestReduceGradients:
 
 
 class TestSyncBatchNorm:
+    def test_shifted_onepass_stats_contract(self):
+        """The single-device one-pass moments are exact within their
+        documented contract: cold start with near-zero means, and steady
+        state (running mean tracking) at ANY magnitude. The adversarial
+        out-of-contract case (cold start at |mean|/std=1000) must be served
+        correctly by stats='two_pass'."""
+        rng = np.random.RandomState(0)
+        # contract case 1: cold start, zero-ish means (standard-init regime)
+        x = rng.randn(64, 3, 32, 32).astype(np.float32)
+        params, state = init_batch_norm(3)
+        y, st = sync_batch_norm(jnp.asarray(x), params, state, training=True)
+        np.testing.assert_allclose(np.asarray(y).std(axis=(0, 2, 3)), 1.0, atol=1e-2)
+
+        # contract case 2: steady state at magnitude 1000 (shift == mean)
+        xl = (1000.0 + rng.randn(64, 3, 32, 32)).astype(np.float32)
+        warm = type(state)(jnp.asarray(xl.mean(axis=(0, 2, 3))), state.running_var)
+        y2, st2 = sync_batch_norm(jnp.asarray(xl), params, warm, training=True)
+        np.testing.assert_allclose(np.asarray(y2).std(axis=(0, 2, 3)), 1.0, atol=1e-2)
+        np.testing.assert_allclose(np.asarray(y2).mean(axis=(0, 2, 3)), 0.0, atol=5e-3)
+
+        # out-of-contract: the two_pass option restores exactness
+        y3, st3 = sync_batch_norm(jnp.asarray(xl), params, state,
+                                  training=True, stats="two_pass")
+        want_var = xl.astype(np.float64).var(axis=(0, 2, 3))
+        got_var = (np.asarray(st3.running_var, np.float64)
+                   - 0.9 * np.asarray(state.running_var)) / 0.1
+        np.testing.assert_allclose(got_var, want_var, rtol=5e-3)
+        np.testing.assert_allclose(np.asarray(y3).std(axis=(0, 2, 3)), 1.0, atol=1e-2)
+
+    def test_shifted_onepass_grads_match_twopass(self):
+        """stop_gradient on the subsample shift is exact: mean/var are
+        shift-invariant, so grads must equal the (sync, two-pass) formula's.
+        Run the same data through the axis_name path on a 1-device mesh as
+        the two-pass reference."""
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        rng = np.random.RandomState(3)
+        x = rng.randn(8, 4, 6, 6).astype(np.float32) * 2.0 + 1.5
+        params, state = init_batch_norm(4)
+
+        def loss_1p(x):
+            y, _ = sync_batch_norm(jnp.asarray(x), params, state, training=True)
+            return jnp.sum(jnp.sin(y))
+
+        mesh1 = Mesh(np.array(jax.devices()[:1]), ("d1",))
+
+        def loss_2p(x):
+            @functools.partial(shard_map, mesh=mesh1, in_specs=(P(),),
+                               out_specs=P())
+            def f(xs):
+                y, _ = sync_batch_norm(xs, params, state, axis_name="d1",
+                                       training=True)
+                return y
+
+            return jnp.sum(jnp.sin(f(x)))
+
+        g1 = jax.grad(loss_1p)(jnp.asarray(x))
+        g2 = jax.grad(loss_2p)(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_matches_torch_bn_over_full_batch(self, data_mesh):
         """SyncBN on 8 shards == torch BatchNorm2d on the concatenated batch."""
         rng = np.random.RandomState(2)

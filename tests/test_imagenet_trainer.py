@@ -62,8 +62,13 @@ class TestOptLevelParity:
         np.testing.assert_allclose(losses, o0_losses, rtol=tol, atol=tol)
 
     def test_o2_keeps_bn_fp32_and_casts_convs(self):
+        """Single-device O2/O5 params live as PackedParams (arena-native);
+        the policy dtypes are visible through unpack()."""
+        from beforeholiday_tpu.ops import PackedParams
+
         tr = _single_device_trainer(opt_level="O2")
-        p = tr.params
+        assert isinstance(tr.params, PackedParams)
+        p = tr.params.unpack()
         assert p["conv1"].dtype == jnp.float16
         assert p["bn1"].scale.dtype == jnp.float32
         assert p["layer2"]["0"]["downsample_bn"].bias.dtype == jnp.float32
@@ -72,9 +77,9 @@ class TestOptLevelParity:
     def test_o5_master_weights_wrap(self):
         tr = _single_device_trainer(opt_level="O5")
         assert "master" in tr.opt_state
-        masters = tr.opt_state["master"]
-        assert masters["conv1"].dtype == jnp.float32
-        assert tr.params["conv1"].dtype == jnp.bfloat16
+        masters = tr.opt_state["master"]  # per-dtype fp32 arenas
+        assert all(m.dtype == jnp.float32 for m in masters)
+        assert tr.params.unpack()["conv1"].dtype == jnp.bfloat16
 
     def test_dynamic_scaler_skips_do_not_poison_params(self):
         """Force an overflow step: params must be unchanged by it
