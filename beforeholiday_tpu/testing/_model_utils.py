@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS, TENSOR_AXIS
@@ -44,3 +45,23 @@ def layernorm(x, scale, bias):
     from beforeholiday_tpu.ops import fused_layer_norm
 
     return fused_layer_norm(x, scale, bias)
+
+
+def vocab_head_matmul(x, embedding):
+    """Tied-embedding logits: ``x @ embedding.T`` in the LOW-precision input
+    dtype with fp32 accumulation (``preferred_element_type``), returning fp32
+    logits.
+
+    An ``x.astype(float32) @ emb`` formulation would force the whole matmul
+    onto the MXU's multi-pass fp32 path — and at GPT-scale vocab the head is
+    30-50% of model FLOPs. The multiply runs in x's COMPUTE dtype (the
+    embedding casts down, the same ``w.astype(x.dtype)`` convention as every
+    other weight use in these models) with an fp32 accumulator — the
+    mixed-precision contract the rest of the stack already uses (cf.
+    ops/attention.py's dot_general calls). A pure-fp32 model is unchanged:
+    both operands are already fp32."""
+    return jax.lax.dot_general(
+        x, embedding.astype(x.dtype),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )

@@ -32,6 +32,7 @@ from jax.sharding import PartitionSpec as P
 
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS, TENSOR_AXIS
 from beforeholiday_tpu.testing._model_utils import (
+    vocab_head_matmul as _vocab_head_matmul,
     constrain as _constrain,
     layernorm as _layernorm,
     residual_spec as _residual_spec,
@@ -251,7 +252,7 @@ def forward(params: dict, tokens: jax.Array, cfg: BertConfig,
     # MLM head: dense+gelu+LN then tied decode (standalone_bert lm head)
     h = jax.nn.gelu(x @ params["mlm_dense"].astype(x.dtype) + params["mlm_bias"].astype(x.dtype))
     h = _layernorm(h, params["mlm_ln_scale"], params["mlm_ln_bias"])
-    mlm = h.astype(jnp.float32) @ params["tok_embed"].T + params["mlm_out_bias"]
+    mlm = _vocab_head_matmul(h, params["tok_embed"]) + params["mlm_out_bias"]
     mlm = _constrain(mlm, P(DATA_AXIS, None, TENSOR_AXIS))
 
     # NSP head off pooled [CLS] (position 0)

@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS, TENSOR_AXIS
 from beforeholiday_tpu.testing._model_utils import (
+    vocab_head_matmul as _vocab_head_matmul,
     constrain as _constrain,
     layernorm as _layernorm,
     residual_spec as _residual_spec,
@@ -209,7 +210,7 @@ def forward(params: dict, tokens: jax.Array, cfg: GPTConfig,
 
         x, _ = jax.lax.scan(body, x, params["blocks"])
     x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
-    logits = x.astype(jnp.float32) @ params["tok_embed"].T
+    logits = _vocab_head_matmul(x, params["tok_embed"])
     return _constrain(logits, P(DATA_AXIS, None, TENSOR_AXIS))
 
 
