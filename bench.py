@@ -67,6 +67,17 @@ R05_GPT_ANALYSIS = (
     "legitimate lever, not because the 512 config is fixable."
 )
 
+# ONE-OFF r5 measurement of the LAMB optimizer's share of the BERT rung
+# (bert_large_8layer b64, 134M params, paired full-vs-fwd+bwd chains,
+# 2026-07-30) — a dated RECORD (VERDICT r4 next #5 asked for the share).
+R05_BERT_LAMB_SHARE = (
+    "[measured on bert_large_8layer_b64] full step ~95-98 ms, fwd+bwd "
+    "~81 ms, packed LAMB step 14-17 ms (~15% of step) at 134M params — "
+    "stage1 + per-tensor trust-ratio norms + stage2 over fp32 master "
+    "arenas, ~60% of streaming roofline (the per-tensor norm machinery "
+    "adds ~2 GB of traffic beyond the Adam-like 3.8 GB)."
+)
+
 # ONE-OFF r5 decomposition of the ResNet-50 O5 step (b128, paired fori_loop
 # probes, 2026-07-30 on the build chip) — a dated RECORD like R04_RECORDED,
 # not something this meter re-measures each run. Device-side XProf is
@@ -644,8 +655,10 @@ def make_gpt_rung():
     small = gpt.GPTConfig(
         vocab_size=8192, seq_len=512, d_model=256, n_heads=4, n_layers=4,
         dtype=jnp.bfloat16)
+    # no b32 for the xl config: the fp32 logits alone are 4.2 GB there and
+    # the attempt reliably exceeds the 16 GB chip — a runtime OOM can poison
+    # the tunnel session for every later rung, so don't even try
     candidates = [
-        ("gpt_1024x16_8layer_s1024_b32", (xl, 32)),
         ("gpt_1024x16_8layer_s1024_b16", (xl, 16)),
         ("gpt_1024x16_8layer_s1024_b8", (xl, 8)),
         ("gpt_512x8_6layer_s1024_b32", (big, 32)),
@@ -799,6 +812,7 @@ def main():
         m = mfu(flops, t)
         if m:
             detail["bert_lamb_mfu"] = m
+        detail["bert_lamb_share_r5_recorded"] = R05_BERT_LAMB_SHARE
         chain = None
     bert_res = None
     _free()
