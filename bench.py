@@ -777,8 +777,8 @@ def main():
         return round(model_flops / dt / 1e12 / peak_tflops, 4)
 
     # Rung order is memory-aware: the big-model rungs run FIRST on a clean
-    # chip (the d1024 GPT flagship's fp32 logits alone are 4.2 GB at b32 —
-    # that candidate only compiles when nothing else is resident — and
+    # chip (the d1024 GPT flagship at b16 peaks ~7 GB transient — fp32
+    # logits 2.1 GB plus dlogits and 8 layers of activations — and
     # BERT-large b64 holds ~2 GB of state), and EVERY rung's arrays are
     # dropped before the next — an OOM on this backend can poison the tunnel
     # session for every stage after it, so ordering is correctness, not

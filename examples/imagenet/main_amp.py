@@ -151,17 +151,19 @@ def build_trainer(
     def apply_eval(p, bn, images):
         return resnet.forward(p, bn, images, cfg, training=False)
 
-    # single-device runs take the arena-native fast path (PackedParams: fp32
-    # masters + optimizer state live flat, grads born flat, master->model
-    # cast fused into the optimizer pass — measured ~4-6 ms/step off the O5
-    # ResNet-50 step at batch 128); the distributed path keeps tree params
-    # (GSPMD/shard_map specs address leaves), and LARC / optimizers without a
-    # flat step keep the list path
+    # O2/O5 take the arena-native fast path (PackedParams: fp32 masters +
+    # optimizer state live flat, grads born flat, master->model cast fused
+    # into the optimizer pass — measured ~4-6 ms/step off the O5 ResNet-50
+    # step at batch 128). This covers the distributed trainer too: its
+    # shard_map replicates params (P() broadcasts over any pytree) and DDP's
+    # grad psum maps over the gradient ARENAS exactly as it maps over leaves
+    # — verified against the single-device oracle in
+    # tests/test_imagenet_trainer.py. LARC / optimizers without a flat step
+    # keep the list path.
     from beforeholiday_tpu.optimizers import supports_flat_step
 
     arena_native = (
         opt is not None
-        and not distributed
         and not use_larc
         and opt_level in ("O2", "O5")
         and supports_flat_step(opt)

@@ -114,14 +114,32 @@ class TestDistributedParity:
 
     def test_ddp_amp_o2_runs_and_converges_direction(self, devices8):
         """O2 + DDP + SyncBN (north-star config 3) trains: loss drops over
-        synthetic memorization of one repeated batch."""
+        synthetic memorization of one repeated batch. The distributed O2
+        path is arena-native too (replicated PackedParams inside shard_map;
+        DDP's psum maps over gradient arenas)."""
+        from beforeholiday_tpu.ops import PackedParams
+
         tr = main_amp.build_trainer(
             cfg=resnet.tiny_test_config(), global_batch=16, num_classes=10,
             distributed=True, devices=devices8, opt_level="O2", sync_bn=True,
         )
+        assert isinstance(tr.params, PackedParams)
         b = _batches(1)
         losses = _run(tr, b * 6, lr=0.1)
         assert losses[-1] < losses[0], losses
+
+    def test_ddp_o5_arena_native_matches_single_device(self, devices8):
+        """8-way DP arena-native O5 == single-device arena-native O5 on the
+        same batches (the DDP semantics oracle, packed edition)."""
+        batches = _batches(3)
+        tr1 = _single_device_trainer(opt_level="O5")
+        l1 = _run(tr1, batches)
+        tr8 = main_amp.build_trainer(
+            cfg=resnet.tiny_test_config(), global_batch=16, num_classes=10,
+            distributed=True, devices=devices8, opt_level="O5", sync_bn=True,
+        )
+        l8 = _run(tr8, batches)
+        np.testing.assert_allclose(l8, l1, rtol=2e-2, atol=2e-2)
 
     def test_eval_step(self, devices8):
         tr = main_amp.build_trainer(
