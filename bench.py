@@ -84,13 +84,18 @@ R05_BERT_LAMB_SHARE = (
 # unavailable through the tunnel (host-only trace), so the attribution came
 # from paired sub-step chains.
 R05_RESNET_ANALYSIS = (
-    "fwd 15 ms of which BN stats ~6 (convs ~32% MFU, stem conv1 81 TFLOP/s "
-    "so no small-channel pathology), bwd ~35 ms (conv dgrad/wgrad at ~18% "
-    "MFU - the hard bound, XLA's conv backward lowering), optimizer+scaler "
-    "~7 ms. r5 fixes: arena-native optimizer step + one-pass-shifted BN "
-    "stats (~5-7 ms combined); batch 256/512 gave no further throughput "
-    "(not batch-starved). Remaining gap to the 2600 img/s north star is "
-    "conv backward efficiency, outside framework control under XLA."
+    "step decomposition at b128: fwd 15 ms (BN batch stats ~6), bwd ~35 ms, "
+    "optimizer+scaler ~7 ms. ISOLATED convs run at 150-190 TF/s fwd AND "
+    "backward (80-100% of chip peak; stem conv1 81 TF/s) - the convs are "
+    "NOT the bound. The bound is the elementwise traffic BETWEEN convs: "
+    "fp32 BN normalize/backward + residual chains over ~0.7 GB of bf16 "
+    "activations x several passes each direction, HBM-bound at the chip's "
+    "~680 GB/s single-buffer streaming rate (conv compute is ~3 ms of the "
+    "8.9 ms eval fwd; the rest is elementwise). r5 fixes: arena-native "
+    "optimizer step + one-pass-shifted BN stats (~5-7 ms combined); batch "
+    "256/512 gave no further throughput. Closing the gap to the 2600 "
+    "img/s north star means cutting elementwise passes (BN-bwd refactoring "
+    "or activation-layout changes), not faster convs."
 )
 
 

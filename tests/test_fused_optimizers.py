@@ -694,6 +694,47 @@ class TestPackedArenaNative:
                 arena_native=True,
             )
 
+    def test_packed_checkpoint_roundtrip(self):
+        """Checkpoint/resume with arena-native state: the packed params and
+        MasterWeights state are plain array pytrees, so a save/restore
+        roundtrip (numpy serialization standing in for orbax) must continue
+        the trajectory bit-for-bit (SURVEY §5 checkpoint/resume applied to
+        the r5 packed path)."""
+        from beforeholiday_tpu.ops.arena import PackedParams
+        from beforeholiday_tpu.optimizers import MasterWeights
+
+        params = self._params()
+        rng = np.random.RandomState(21)
+        x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        y = jnp.asarray(rng.randn(4, 4).astype(np.float32))
+        mw = MasterWeights(FusedAdam(lr=1e-2, weight_decay=0.01), arena=True)
+        pk = PackedParams.pack(params)
+        st = mw.init(pk)
+
+        @jax.jit
+        def step(pk, st):
+            g = jax.grad(lambda pk: self._loss(pk.unpack(), x, y))(pk)
+            return mw.step(pk, g, st)
+
+        for _ in range(2):
+            pk, st = step(pk, st)
+
+        # "save": arenas + state leaves to host numpy; "restore": rebuild
+        # the PackedParams from the SAME layout (the layout is static
+        # metadata, reconstructible from the param tree template)
+        saved_arenas = [np.asarray(a) for a in pk.arenas]
+        saved_state = jax.tree.map(np.asarray, st)
+        layout = PackedParams.pack(params).layout  # from the model template
+        pk_r = PackedParams([jnp.asarray(a) for a in saved_arenas], layout)
+        st_r = jax.tree.map(jnp.asarray, saved_state)
+
+        pk_a, st_a = step(pk, st)
+        pk_b, st_b = step(pk_r, st_r)
+        for a, b in zip(pk_a.arenas, pk_b.arenas):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(st_a), jax.tree.leaves(st_b)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
 
 def arena_TILE():
     from beforeholiday_tpu.ops.arena import TILE
