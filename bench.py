@@ -203,6 +203,21 @@ def _sub_ratio(times, a, b, gen_a=None, gen_b=None):
     return float(np.median(ratios)) if ratios else float("nan")
 
 
+def _unstable_keys(detail: dict, pass2: dict, tol: float = 0.10) -> list:
+    """THE stability gate: keys whose pass-2 value disagrees with pass 1 by
+    more than ``tol`` relative. Missing or zero pass-1 entries are skipped
+    (a zero would make the relative test meaningless). main() calls this;
+    tests/test_bench_meter.py pins it."""
+    out = []
+    for k, v2 in pass2.items():
+        v1 = detail.get(k)
+        if v1 is None or v1 == 0 or not np.isfinite(v2):
+            continue
+        if abs(v2 - v1) > tol * abs(v1):
+            out.append(k)
+    return out
+
+
 def _med_sub(times, a, gen=None):
     vals = [
         times[a][i] - (times[gen][i] if gen else 0.0)
@@ -924,11 +939,7 @@ def main():
         detail["pp_note"] = "schedule-logic proxy on an 8-CPU mesh, not a TPU number"
 
     # --- stability gate: pass-2 must agree within 10% on every ratio ---
-    unstable = []
-    for k, v2 in pass2.items():
-        v1 = detail.get(k)
-        if v1 and np.isfinite(v2) and abs(v2 - v1) > 0.10 * abs(v1):
-            unstable.append(k)
+    unstable = _unstable_keys(detail, pass2)
     detail["meter"] = {
         "method": "fori_loop-chained, gen-subtracted, paired; two passes",
         "stable": not unstable,

@@ -1,0 +1,76 @@
+"""The bench meter's machinery must work headlessly (the driver runs
+bench.py unattended at round end — a broken Chain/ratio helper silently
+destroys the round's perf record). These run the meter's pure parts on CPU;
+the rungs themselves are TPU-only by construction."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import bench  # noqa: E402
+
+
+class TestChain:
+    def test_runs_and_counts_iterations(self):
+        """A counting chain proves the traced trip count actually drives the
+        loop AND that changing n does not recompile (one jit cache entry —
+        calibration sweeps n, so a static trip count would compile dozens of
+        variants and skew every timing with compile stalls)."""
+        c = bench.Chain(lambda s: s + 1.0, jnp.float32(0.0))
+        out = c.run(jnp.int32(7), c.state)
+        assert float(out) == 7.0
+        out = c.run(jnp.int32(19), c.state)  # same compiled fn, new n
+        assert float(out) == 19.0
+        assert c.run._cache_size() == 1
+
+    def test_invariants_passed_through(self):
+        c = bench.Chain(lambda s, k: s * k, jnp.float32(1.0), (jnp.float32(2.0),))
+        assert float(c.run(jnp.int32(5), c.state, *c.inv)) == 32.0
+
+    def test_calibrate_picks_positive_n(self):
+        c = bench.Chain(lambda s: s * 0.5 + 1.0, jnp.float32(0.0)).calibrate(
+            target_s=0.01)
+        assert c.n >= 1
+        t = c.sample()
+        assert t > 0
+
+    def test_nonfinite_state_raises(self):
+        c = bench.Chain(lambda s: s * 2.0, jnp.float32(1e38))
+        c.n = 64
+        with pytest.raises(RuntimeError, match="non-finite"):
+            c.sample()
+
+
+class TestRatioHelpers:
+    def test_sub_ratio_subtracts_each_side_baseline(self):
+        times = {
+            "a": [5.0, 5.0], "b": [3.0, 3.0],
+            "ga": [1.0, 1.0], "gb": [2.0, 2.0],
+        }
+        r = bench._sub_ratio(times, "a", "b", "ga", "gb")
+        assert r == pytest.approx((5 - 1) / (3 - 2))
+
+    def test_sub_ratio_median_over_pairs(self):
+        times = {"a": [2.0, 4.0, 100.0], "b": [1.0, 2.0, 50.0]}
+        assert bench._sub_ratio(times, "a", "b") == pytest.approx(2.0)
+
+    def test_med_sub(self):
+        times = {"a": [3.0, 5.0, 4.0], "g": [1.0, 1.0, 1.0]}
+        assert bench._med_sub(times, "a", "g") == pytest.approx(3.0)
+
+
+class TestStabilityGate:
+    def test_gate_flags_only_out_of_tolerance_keys(self):
+        detail = {"r1": 1.0, "r2": 2.0}
+        pass2 = {"r1": 1.05, "r2": 2.5}
+        assert bench._unstable_keys(detail, pass2) == ["r2"]
+
+    def test_gate_skips_missing_zero_and_nonfinite(self):
+        detail = {"zero": 0.0, "ok": 1.0}
+        pass2 = {"zero": 5.0, "missing": 9.0, "ok": float("nan")}
+        assert bench._unstable_keys(detail, pass2) == []
