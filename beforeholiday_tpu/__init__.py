@@ -22,6 +22,9 @@ capability surface of NVIDIA Apex (reference: /root/reference):
 - ``beforeholiday_tpu.rnn``         — LSTM/GRU/ReLU/Tanh/mLSTM cells (ref: apex/RNN/).
 - ``beforeholiday_tpu.fp16_utils``  — the deprecated explicit master-weight API
   (ref: apex/fp16_utils/).
+- ``beforeholiday_tpu.guard``       — robustness layer: probe-guarded Pallas dispatch
+  (degrade to the jnp oracle instead of raising) and the StepGuard device-side
+  skip/rollback state machine generalizing the loss scaler.
 
 Unlike the reference, which grafts CUDA kernels onto PyTorch via monkey-patching,
 this framework is functional and mesh-first: precision policies are dtype policies
@@ -31,6 +34,7 @@ collective is a `jax.lax` collective over named mesh axes carried on ICI/DCN.
 
 from beforeholiday_tpu import amp
 from beforeholiday_tpu import fp16_utils
+from beforeholiday_tpu import guard
 from beforeholiday_tpu import ops
 from beforeholiday_tpu import optimizers
 from beforeholiday_tpu import parallel
@@ -43,6 +47,7 @@ __version__ = "0.1.0"
 __all__ = [
     "amp",
     "fp16_utils",
+    "guard",
     "ops",
     "optimizers",
     "parallel",

@@ -144,7 +144,14 @@ class AmpModel:
     def state_dict(self, scaler_state) -> Dict[str, Any]:
         """Scaler checkpoint (ref: apex/amp/frontend.py:434-452 amp.state_dict
         — one ``loss_scaler{i}`` entry per loss). ``scaler_state`` is the
-        single state, or a sequence of per-loss states when num_losses > 1."""
+        single state, or a sequence of per-loss states when num_losses > 1.
+
+        A :class:`~beforeholiday_tpu.guard.StepGuard` state (recognized by its
+        ``health`` key) may be passed in place of a bare scaler state: its
+        embedded scaler serializes as ``loss_scaler{i}`` as before, and the
+        health counters ride along as ``health{i}``. The rollback snapshot is
+        deliberately NOT serialized (it is model-sized and re-seeded from the
+        checkpointed params via :meth:`StepGuard.load_state_dict`)."""
         states = (
             list(scaler_state)
             if isinstance(scaler_state, (list, tuple))
@@ -154,18 +161,32 @@ class AmpModel:
             raise ValueError(
                 f"expected {len(self.scalers)} scaler states, got {len(states)}"
             )
-        return {
-            f"loss_scaler{i}": s.state_dict(st)
-            for i, (s, st) in enumerate(zip(self.scalers, states))
-        }
+        out: Dict[str, Any] = {}
+        for i, (s, st) in enumerate(zip(self.scalers, states)):
+            if isinstance(st, dict) and "health" in st:
+                out[f"loss_scaler{i}"] = s.state_dict(st["scaler"])
+                out[f"health{i}"] = {k: int(v) for k, v in st["health"].items()}
+            else:
+                out[f"loss_scaler{i}"] = s.state_dict(st)
+        return out
 
     def load_state_dict(self, state_dict):
         """Inverse of ``state_dict`` (ref: frontend.py:454-473). Returns the
-        single scaler state, or the list of per-loss states."""
-        out = [
-            s.load_state_dict(state_dict[f"loss_scaler{i}"])
-            for i, s in enumerate(self.scalers)
-        ]
+        single scaler state, or the list of per-loss states. Entries saved
+        with a ``health{i}`` sibling come back as guard-shaped states
+        (``{"scaler": ..., "health": ...}``, no snapshot — re-seed it through
+        :meth:`StepGuard.load_state_dict` when rollback is armed)."""
+        out = []
+        for i, s in enumerate(self.scalers):
+            sstate = s.load_state_dict(state_dict[f"loss_scaler{i}"])
+            if f"health{i}" in state_dict:
+                health = {
+                    k: jnp.int32(v)
+                    for k, v in state_dict[f"health{i}"].items()
+                }
+                out.append({"scaler": sstate, "health": health})
+            else:
+                out.append(sstate)
         return out[0] if len(out) == 1 else out
 
 

@@ -45,7 +45,20 @@ class LossScaler:
         return {
             "scale": jnp.float32(scale),
             "unskipped": jnp.int32(0),
+            "consecutive_overflows": jnp.int32(0),
         }
+
+    def at_min_scale(self, state) -> jax.Array:
+        """True when the scale cannot shrink further — the reference halves
+        silently into the ``min_loss_scale`` clamp forever (scaler.py:210-214);
+        exposing the floor lets the step guard's rollback key off
+        "still overflowing AND shrinking is exhausted". A static scale can
+        never shrink; a dynamic scaler without a floor always can."""
+        if not self.dynamic:
+            return jnp.bool_(True)
+        if self.min_loss_scale is None:
+            return jnp.bool_(False)
+        return state["scale"] <= self.min_loss_scale
 
     def scale_loss(self, loss: jax.Array, state) -> jax.Array:
         """loss.float() * loss_scale (ref: apex/amp/handle.py:113)."""
@@ -80,10 +93,22 @@ class LossScaler:
 
         overflow → scale /= factor, counter reset; scale_window clean steps →
         scale *= factor. Pure ``where`` arithmetic — no host sync, jittable.
+
+        ``consecutive_overflows`` counts back-to-back skipped steps (reset on
+        any clean step) for BOTH dynamic and static scales: once the dynamic
+        scale is clamped at ``min_loss_scale`` the shrink is a silent no-op,
+        and this counter is the visible evidence — the step guard's rollback
+        keys off it together with :meth:`at_min_scale`. Old states without the
+        key are tolerated (pre-guard checkpoints).
         """
-        if not self.dynamic:
-            return state
         skip = jnp.asarray(found_inf) != 0
+        consec = jnp.where(
+            skip,
+            state.get("consecutive_overflows", jnp.int32(0)) + 1,
+            0,
+        ).astype(jnp.int32)
+        if not self.dynamic:
+            return {**state, "consecutive_overflows": consec}
         scale, unskipped = state["scale"], state["unskipped"]
 
         shrunk = scale / self.scale_factor
@@ -95,7 +120,11 @@ class LossScaler:
 
         new_scale = jnp.where(skip, shrunk, jnp.where(grow, grown, scale))
         new_unskipped = jnp.where(grow, 0, unskipped_next)
-        return {"scale": new_scale, "unskipped": new_unskipped}
+        return {
+            "scale": new_scale,
+            "unskipped": new_unskipped,
+            "consecutive_overflows": consec,
+        }
 
     # --- checkpointing (ref: apex/amp/frontend.py:434-473) ----------------------
 
@@ -103,10 +132,18 @@ class LossScaler:
         return {
             "loss_scale": float(state["scale"]),
             "unskipped": int(state["unskipped"]),
+            "consecutive_overflows": int(
+                state.get("consecutive_overflows", 0)
+            ),
         }
 
     def load_state_dict(self, state_dict) -> Dict[str, jax.Array]:
+        # accept pre-guard dicts without the counter — checkpoints round-trip
+        # across the schema change in both directions
         return {
             "scale": jnp.float32(state_dict["loss_scale"]),
             "unskipped": jnp.int32(state_dict["unskipped"]),
+            "consecutive_overflows": jnp.int32(
+                state_dict.get("consecutive_overflows", 0)
+            ),
         }

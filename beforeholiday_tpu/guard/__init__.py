@@ -1,0 +1,38 @@
+"""Guardrails — graceful degradation and testable failure paths.
+
+The reference treats robustness as a single device-side ``_overflow_buf``
+consumed by the amp scaler (apex/amp/scaler.py:42-226); kernel build failures,
+silently diverging ranks, and persistent NaNs at ``min_loss_scale`` are the
+user's problem. This subsystem makes every one of those failure paths explicit,
+device-side, and exercisable under ``JAX_PLATFORMS=cpu``:
+
+* ``dispatch`` — guarded Pallas dispatch: probe-compile once per
+  (shape/dtype/backend) key, cache the verdict, degrade to the jnp oracle with
+  one structured warning instead of raising. Wired into the default-on Pallas
+  ops (normalization, softmax, attention, multi_tensor).
+* ``step`` — :class:`StepGuard`, a jittable device-side state machine
+  generalizing :class:`~beforeholiday_tpu.amp.scaler.LossScaler`: non-finite
+  sentinels on loss/grads/updated-params, a skip-step ``where``-select threaded
+  through the fused optimizers, last-good-params rollback after K consecutive
+  overflows at ``min_loss_scale``, and a ``health`` pytree surfaced through the
+  amp ``state_dict``/``load_state_dict``.
+
+Fault injectors live in :mod:`beforeholiday_tpu.testing.faults` (test-side, not
+part of the runtime surface).
+"""
+
+from beforeholiday_tpu.guard.dispatch import (  # noqa: F401
+    checked_impl,
+    clear_probe_cache,
+    probe_failures,
+    set_probe_mode,
+)
+from beforeholiday_tpu.guard.step import (  # noqa: F401
+    SKIP_NONE,
+    SKIP_GRAD_OVERFLOW,
+    SKIP_LOSS_NONFINITE,
+    SKIP_PARAM_NONFINITE,
+    SKIP_ROLLBACK,
+    SKIP_REASON_NAMES,
+    StepGuard,
+)

@@ -1,0 +1,179 @@
+"""Guarded Pallas dispatch — probe once per static key, degrade to jnp.
+
+``_pallas_util.resolve_impl`` picks ``pallas`` wherever the traced program owns
+one device per shard, but has no recourse if the kernel then fails to build for
+an odd shape/dtype (the reference's per-extension ``is_kernel_available`` gates,
+fused_softmax.py:164, only check shapes they anticipated). :func:`checked_impl`
+closes that hole: before the first pallas call for a given
+(op, backend, shapes/dtypes, statics) key, the kernel is probe-built in a
+throwaway trace; on failure the op degrades to its jnp oracle with ONE
+structured warning via :mod:`beforeholiday_tpu.utils.logging` instead of
+raising. The verdict is cached, so the happy path after the first call is a
+dict lookup at trace time — nothing enters the compiled step, and no host sync.
+
+Probe depth:
+
+* ``"trace"``   — ``jax.eval_shape`` over ShapeDtypeStructs: catches BlockSpec /
+  tiling / shape-contract errors (the failure class reachable on CPU, where the
+  Pallas interpreter has no Mosaic stage). Cheap; safe inside an outer trace.
+* ``"compile"`` — full ``jit(...).lower(...).compile()``: additionally catches
+  Mosaic lowering errors on a real TPU backend. Only attempted outside any
+  ambient trace (a probe compile inside ``shard_map`` tracing would not see the
+  per-shard lowering context and could mis-verdict).
+* ``"off"``     — trust the kernel (no probe).
+
+The default ``"auto"`` resolves to ``compile`` on a clean-trace TPU backend and
+``trace`` everywhere else.
+
+Fault injection (:func:`beforeholiday_tpu.testing.faults.force_probe_failure`)
+registers op names in :data:`_FORCED_FAILURES`; the probe consults it first, so
+the degradation path is exercisable on any backend.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Set, Tuple
+
+import jax
+
+from beforeholiday_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# key -> None (probe passed) | str (failure summary; already warned)
+_VERDICTS: Dict[Tuple, Optional[str]] = {}
+_VERDICTS_LOCK = threading.Lock()
+_FORCED_FAILURES: Set[str] = set()
+_PROBE_MODE = "auto"  # "auto" | "compile" | "trace" | "off"
+
+
+class InjectedProbeFailure(RuntimeError):
+    """Raised by the probe when a fault injector forced this op to fail."""
+
+
+def set_probe_mode(mode: str) -> str:
+    """Set the probe depth globally; returns the previous mode."""
+    global _PROBE_MODE
+    if mode not in ("auto", "compile", "trace", "off"):
+        raise ValueError(f"probe mode must be auto/compile/trace/off, got {mode!r}")
+    prev, _PROBE_MODE = _PROBE_MODE, mode
+    return prev
+
+
+def clear_probe_cache(op_name: Optional[str] = None) -> None:
+    """Drop cached verdicts (all, or one op's) — next call re-probes."""
+    with _VERDICTS_LOCK:
+        if op_name is None:
+            _VERDICTS.clear()
+        else:
+            for key in [k for k in _VERDICTS if k[0] == op_name]:
+                del _VERDICTS[key]
+
+
+def probe_failures() -> Dict[Tuple, str]:
+    """Snapshot of keys that failed their probe (key -> failure summary)."""
+    with _VERDICTS_LOCK:
+        return {k: v for k, v in _VERDICTS.items() if v is not None}
+
+
+def _is_arrayish(x: Any) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _trace_clean() -> bool:
+    try:
+        return jax.core.trace_state_clean()
+    except Exception:
+        return False
+
+
+def _probe(op_name: str, fn: Callable, args: tuple, kw: dict) -> None:
+    """Build ``fn(*args, **kw)`` in a throwaway trace; raise on failure.
+
+    Array args and kwargs (including tracers from an enclosing trace) are
+    replaced by ShapeDtypeStructs so the probe never touches live values;
+    everything else passes through as statics.
+    """
+    if op_name in _FORCED_FAILURES:
+        raise InjectedProbeFailure(f"probe failure injected for {op_name!r}")
+    mode = _PROBE_MODE
+    if mode == "off":
+        return
+    if mode == "auto":
+        mode = (
+            "compile"
+            if jax.default_backend() == "tpu" and _trace_clean()
+            else "trace"
+        )
+    structs, spots = [], []
+    for i, a in enumerate(args):
+        if _is_arrayish(a):
+            structs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+            spots.append(i)
+    kw_spots = sorted(k for k, v in kw.items() if _is_arrayish(v))
+    structs.extend(jax.ShapeDtypeStruct(kw[k].shape, kw[k].dtype) for k in kw_spots)
+
+    def probe_fn(*arrays):
+        full = list(args)
+        for i, x in zip(spots, arrays):
+            full[i] = x
+        full_kw = dict(kw)
+        for k, x in zip(kw_spots, arrays[len(spots):]):
+            full_kw[k] = x
+        return fn(*full, **full_kw)
+
+    if mode == "compile" and _trace_clean():
+        jax.jit(probe_fn).lower(*structs).compile()
+    else:
+        jax.eval_shape(probe_fn, *structs)
+
+
+def checked_impl(
+    op_name: str,
+    impl: str,
+    fn: Callable,
+    *args: Any,
+    statics: Tuple = (),
+    **kw: Any,
+) -> str:
+    """Downgrade ``impl`` 'pallas' -> 'jnp' when the kernel probe fails.
+
+    ``fn(*args, **kw)`` must be the exact pallas path the caller is about to
+    take; array args contribute (shape, dtype) to the cache key, everything
+    else (plus ``statics``) is keyed by repr. Returns the impl to use. Never
+    raises from the probe: any probe exception caches a failed verdict, emits
+    exactly one structured warning, and selects the oracle.
+    """
+    if impl != "pallas":
+        return impl
+    sig = lambda a: (a.shape, str(a.dtype)) if _is_arrayish(a) else repr(a)
+    key = (
+        op_name,
+        jax.default_backend(),
+        tuple(sig(a) for a in args),
+        tuple(sorted((k, sig(v)) for k, v in kw.items())),
+        tuple(repr(s) for s in statics),
+    )
+    with _VERDICTS_LOCK:
+        if key in _VERDICTS:
+            return "jnp" if _VERDICTS[key] is not None else "pallas"
+    try:
+        _probe(op_name, fn, args, kw)
+    except Exception as e:  # noqa: BLE001 — degradation IS the contract
+        summary = f"{type(e).__name__}: {e}"
+        fresh = False
+        with _VERDICTS_LOCK:
+            if key not in _VERDICTS:
+                _VERDICTS[key] = summary
+                fresh = True
+        if fresh:
+            logger.warning(
+                "guarded dispatch: op=%s key=%s probe failed (%s); "
+                "degrading to the jnp oracle for this key",
+                op_name, key[2], summary,
+            )
+        return "jnp"
+    with _VERDICTS_LOCK:
+        _VERDICTS.setdefault(key, None)
+    return "pallas"

@@ -1,0 +1,304 @@
+"""StepGuard — a jittable, device-side robustness state machine for training.
+
+Generalizes :class:`~beforeholiday_tpu.amp.scaler.LossScaler`'s skip-step: the
+scaler detects gradient overflow through the fused ``multi_tensor_scale`` flag
+(apex/amp/scaler.py:114-126); the guard adds non-finite sentinels on the loss
+and the UPDATED params, threads the combined skip decision into the fused
+optimizers as their ``found_inf`` identity-select, and carries a last-good
+params snapshot that is restored after K consecutive overflows at
+``min_loss_scale`` — the "persistent NaN" end state the reference leaves to
+the user. Everything is ``where``-select arithmetic on device state: no host
+sync, no ``lax.cond`` host branches, fully jittable.
+
+Skip reasons are small int codes (a device-side enum — strings cannot live in
+traced state)::
+
+    0 none | 1 grad overflow | 2 loss non-finite | 3 param non-finite | 4 rollback
+
+Usage::
+
+    guard = StepGuard(LossScaler(min_loss_scale=1.0), rollback_after=3,
+                      check_params=True)
+    gstate = guard.init(params)
+    vg = guard.value_and_grad(loss_fn)
+
+    @jax.jit
+    def train_step(params, opt_state, gstate, batch):
+        loss, grads, verdict = vg(params, gstate, batch)
+        params, opt_state, gstate = guard.apply_update(
+            opt, params, grads, opt_state, gstate, verdict)
+        return params, opt_state, gstate, loss
+
+The ``health`` pytree (``consecutive_overflows``, ``skipped_total``,
+``last_skip_reason``, ``rollbacks_total``) rides in ``gstate`` and is surfaced
+through the amp ``state_dict``/``load_state_dict``
+(:meth:`beforeholiday_tpu.amp.AmpModel.state_dict` serializes it as
+``health{i}`` alongside ``loss_scaler{i}``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # imported lazily at runtime: ops -> guard -> amp would cycle
+    from beforeholiday_tpu.amp.scaler import LossScaler
+
+SKIP_NONE = 0
+SKIP_GRAD_OVERFLOW = 1
+SKIP_LOSS_NONFINITE = 2
+SKIP_PARAM_NONFINITE = 3
+SKIP_ROLLBACK = 4
+
+SKIP_REASON_NAMES = {
+    SKIP_NONE: "none",
+    SKIP_GRAD_OVERFLOW: "grad_overflow",
+    SKIP_LOSS_NONFINITE: "loss_nonfinite",
+    SKIP_PARAM_NONFINITE: "param_nonfinite",
+    SKIP_ROLLBACK: "rollback",
+}
+
+_HEALTH_KEYS = (
+    "consecutive_overflows",
+    "skipped_total",
+    "last_skip_reason",
+    "rollbacks_total",
+)
+
+
+def _tree_nonfinite(tree) -> jax.Array:
+    """True iff any inexact leaf holds a non-finite value."""
+    flags = [
+        jnp.any(~jnp.isfinite(l))
+        for l in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+    ]
+    if not flags:
+        return jnp.bool_(False)
+    return jnp.stack(flags).any()
+
+
+def _tree_select(pred, on_true, on_false):
+    """Elementwise pytree select — ``where`` keeps it one fused pass, and a
+    skipped step's params come back BIT-identical to ``on_true``."""
+    return jax.tree_util.tree_map(
+        lambda t, f: jnp.where(pred, t, f), on_true, on_false
+    )
+
+
+class StepGuard:
+    """Static guard config; all dynamics live in the ``gstate`` pytree.
+
+    ``rollback_after=K`` (0 disables) arms the last-good-params snapshot:
+    after K consecutive skipped steps while the scaler can shrink no further
+    (:meth:`LossScaler.at_min_scale`), params are restored to the last clean
+    step's values — bounded-staleness recovery instead of a permanently
+    poisoned run. ``check_params=True`` additionally screens the UPDATED
+    params each step (catches lr/eps blowups the grad sentinel cannot see)
+    and reverts params AND optimizer state when they come back non-finite.
+    """
+
+    def __init__(
+        self,
+        scaler: "Optional[LossScaler]" = None,
+        *,
+        rollback_after: int = 0,
+        check_params: bool = False,
+    ):
+        if rollback_after < 0:
+            raise ValueError(f"rollback_after must be >= 0, got {rollback_after}")
+        if scaler is None:
+            from beforeholiday_tpu.amp.scaler import LossScaler
+
+            scaler = LossScaler()
+        self.scaler = scaler
+        self.rollback_after = int(rollback_after)
+        self.check_params = bool(check_params)
+
+    # --- state ------------------------------------------------------------------
+
+    def init(self, params: Any) -> Dict[str, Any]:
+        state = {
+            "scaler": self.scaler.init(),
+            "health": {k: jnp.int32(0) for k in _HEALTH_KEYS},
+        }
+        if self.rollback_after:
+            state["snapshot"] = jax.tree_util.tree_map(jnp.asarray, params)
+        return state
+
+    # --- sentinels --------------------------------------------------------------
+
+    def value_and_grad(
+        self,
+        loss_fn: Callable,
+        *,
+        has_aux: bool = False,
+        impl=None,
+        reduce_grads: Optional[Callable] = None,
+    ) -> Callable:
+        """Like :func:`beforeholiday_tpu.amp.scaled_value_and_grad`, but the
+        scaler state does NOT advance here — the final skip decision (which may
+        include the post-step param sentinel) is only known in
+        :meth:`apply_update`, which owns the scale update.
+
+        Returns ``f(params, gstate, *args) -> (loss, [aux,] grads, verdict)``
+        with fp32 unscaled grads and a verdict dict of traced bools
+        (``grad_overflow``, ``loss_nonfinite``). ``reduce_grads`` runs on the
+        still-scaled grads before unscale (the reference's hot-loop order), so
+        every rank sees the reduced grads and takes the same skip decision.
+        """
+
+        def wrapped(params, gstate, *args, **kw):
+            sstate = gstate["scaler"]
+
+            def scaled_loss_fn(p):
+                res = loss_fn(p, *args, **kw)
+                loss, aux = res if has_aux else (res, None)
+                return self.scaler.scale_loss(loss, sstate), (loss, aux)
+
+            grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(params)
+            if reduce_grads is not None:
+                grads = reduce_grads(grads)
+            grads, grad_inf = self.scaler.unscale(grads, sstate, impl=impl)
+            verdict = {
+                "grad_overflow": jnp.asarray(grad_inf) != 0,
+                "loss_nonfinite": _tree_nonfinite(loss),
+            }
+            if has_aux:
+                return loss, aux, grads, verdict
+            return loss, grads, verdict
+
+        return wrapped
+
+    def check_grads(self, loss, grads) -> Dict[str, jax.Array]:
+        """Build a verdict from externally produced (loss, grads) — for steps
+        that do not route through :meth:`value_and_grad` (e.g. pre-unscaled
+        fp32 training, or grads arriving from a pipeline schedule)."""
+        return {
+            "grad_overflow": _tree_nonfinite(grads),
+            "loss_nonfinite": _tree_nonfinite(loss),
+        }
+
+    # --- the guarded update ----------------------------------------------------
+
+    def apply_update(
+        self,
+        opt,
+        params,
+        grads,
+        opt_state,
+        gstate,
+        verdict: Dict[str, jax.Array],
+        *,
+        grad_scale=1.0,
+        **opt_kw,
+    ):
+        """One guarded optimizer step. Returns (params, opt_state, gstate).
+
+        Order of operations (all device-side selects):
+
+        1. optimizer step with ``found_inf = grad_overflow | loss_nonfinite``
+           — the fused kernels' identity-select skip (moments and step counter
+           hold, apex/amp/handle.py:127-154);
+        2. param sentinel (``check_params``): non-finite updated params revert
+           params AND optimizer state to their pre-step values;
+        3. scale update with the TOTAL skip — so a param-sentinel trip also
+           shrinks the scale (it is an overflow the grad flag missed);
+        4. health bookkeeping; ``consecutive_overflows`` mirrors the scaler's
+           own counter (single source of truth);
+        5. rollback: after ``rollback_after`` consecutive overflows with the
+           scaler at its floor, params := snapshot; on clean steps
+           snapshot := new params.
+        """
+        pre_inf = verdict["grad_overflow"] | verdict["loss_nonfinite"]
+        new_params, new_opt_state = opt.step(
+            params, grads, opt_state,
+            found_inf=pre_inf, grad_scale=grad_scale, **opt_kw,
+        )
+
+        param_bad = jnp.bool_(False)
+        if self.check_params:
+            param_bad = _tree_nonfinite(new_params) & ~pre_inf
+            new_params = _tree_select(param_bad, params, new_params)
+            new_opt_state = _tree_select(param_bad, opt_state, new_opt_state)
+        skip = pre_inf | param_bad
+
+        sstate = self.scaler.update(gstate["scaler"], skip)
+        consec = sstate.get(
+            "consecutive_overflows",
+            jnp.where(skip, gstate["health"]["consecutive_overflows"] + 1, 0),
+        )
+
+        reason_now = jnp.where(
+            verdict["loss_nonfinite"],
+            SKIP_LOSS_NONFINITE,
+            jnp.where(
+                verdict["grad_overflow"], SKIP_GRAD_OVERFLOW, SKIP_PARAM_NONFINITE
+            ),
+        )
+        health = dict(gstate["health"])
+        health["skipped_total"] = health["skipped_total"] + skip.astype(jnp.int32)
+        health["last_skip_reason"] = jnp.where(
+            skip, reason_now, health["last_skip_reason"]
+        ).astype(jnp.int32)
+
+        new_state = {"scaler": sstate, "health": health}
+        if self.rollback_after:
+            snapshot = gstate["snapshot"]
+            trigger = (
+                skip
+                & (consec >= self.rollback_after)
+                & self.scaler.at_min_scale(sstate)
+            )
+            new_params = _tree_select(trigger, snapshot, new_params)
+            new_state["snapshot"] = _tree_select(skip, snapshot, new_params)
+            consec = jnp.where(trigger, 0, consec)
+            if "consecutive_overflows" in sstate:
+                sstate = dict(sstate)
+                sstate["consecutive_overflows"] = jnp.asarray(consec, jnp.int32)
+                new_state["scaler"] = sstate
+            health["rollbacks_total"] = (
+                health["rollbacks_total"] + trigger.astype(jnp.int32)
+            )
+            health["last_skip_reason"] = jnp.where(
+                trigger, SKIP_ROLLBACK, health["last_skip_reason"]
+            ).astype(jnp.int32)
+        health["consecutive_overflows"] = jnp.asarray(consec, jnp.int32)
+
+        return new_params, new_opt_state, new_state
+
+    # --- checkpointing ----------------------------------------------------------
+    #
+    # Host-side by contract, like the scaler's (ref: apex/amp/frontend.py:434-473)
+    # — the int()/float() readbacks here are the ONE sanctioned sync point.
+
+    def state_dict(self, gstate) -> Dict[str, Any]:
+        out = self.scaler.state_dict(gstate["scaler"])
+        out["health"] = {k: int(gstate["health"][k]) for k in _HEALTH_KEYS}
+        return out
+
+    def load_state_dict(self, state_dict, params: Any = None) -> Dict[str, Any]:
+        """Inverse of :meth:`state_dict`. Accepts pre-guard dicts (no
+        ``health`` key -> zero health). ``params`` re-seeds the rollback
+        snapshot (required when ``rollback_after`` is armed — the snapshot is
+        model-sized and deliberately not checkpointed twice)."""
+        scaler_sd = {k: v for k, v in state_dict.items() if k != "health"}
+        health_sd = state_dict.get("health", {})
+        state = {
+            "scaler": self.scaler.load_state_dict(scaler_sd),
+            "health": {
+                k: jnp.int32(health_sd.get(k, 0)) for k in _HEALTH_KEYS
+            },
+        }
+        if self.rollback_after:
+            if params is None:
+                raise ValueError(
+                    "rollback_after is armed: load_state_dict needs params to "
+                    "re-seed the last-good snapshot"
+                )
+            state["snapshot"] = jax.tree_util.tree_map(jnp.asarray, params)
+        return state

@@ -23,16 +23,21 @@ def in_fully_manual_context() -> bool:
     pallas_call is rejected at trace time because its out_shapes carry no
     ``vma``; the default must stay jnp there rather than regress working
     user code."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if not mesh.axis_names:
-        return False
-    if not all(t == jax.sharding.AxisType.Manual for t in mesh.axis_types):
-        return False
     try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if not mesh.axis_names:
+            return False
+        if not all(t == jax.sharding.AxisType.Manual for t in mesh.axis_types):
+            return False
         from jax._src.config import _check_vma
-    except ImportError:  # future jax relocation: fail safe to jnp
+
+        return not _check_vma.value
+    except (ImportError, AttributeError):
+        # fail safe to jnp on EVERY probe failure mode: the abstract-mesh /
+        # AxisType API absent on older jax, the _check_vma module relocated
+        # (ImportError), or the attribute moved/changed shape while the module
+        # survived (AttributeError on the name or on ``.value``)
         return False
-    return not _check_vma.value
 
 
 def resolve_impl(impl: Optional[str]) -> str:

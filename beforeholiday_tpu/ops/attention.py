@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from beforeholiday_tpu.guard.dispatch import checked_impl as _checked_impl
 from beforeholiday_tpu.ops._autocast import autocast_dtype
 from beforeholiday_tpu.ops._pallas_util import (
     interpret_default as _interpret_default,
@@ -473,6 +474,18 @@ def _flash3_lse_bwd(causal, scale, res, cts):
 _flash3_lse.defvjp(_flash3_lse_fwd, _flash3_lse_bwd)
 
 
+def _probe_flash_pallas(q3, k3, v3, lens_bh, seed, *, causal, scale, rate):
+    """Guard probe: forward AND backward flash kernels must build for the key
+    (the bwd pass launches two extra pallas_calls with their own specs)."""
+
+    def f(q, k, v):
+        return _flash3(q, k, v, lens_bh, seed, causal, scale, rate)
+
+    o, vjp = jax.vjp(f, q3, k3, v3)
+    vjp(jnp.zeros_like(o))
+    return o
+
+
 def _seed_from_key(key: jax.Array) -> jax.Array:
     """(1,) int32 kernel seed derived from a PRNG key — the key stays the
     user-facing contract (fold_in composability with the RNG tracker), the
@@ -618,6 +631,15 @@ def flash_attention(
                 seed = _seed_from_key(dropout_key)
             else:
                 seed = jnp.zeros((1,), jnp.int32)
+            if not forced:
+                # default-on dispatch is guarded; a forced impl='pallas'
+                # keeps the honor-or-raise contract above
+                impl = _checked_impl(
+                    "flash_attention", impl, _probe_flash_pallas,
+                    q3, k3, v3, lens_bh, seed,
+                    causal=causal, scale=scale, rate=float(dropout_rate),
+                )
+        if impl == "pallas":
             o = _flash3(q3, k3, v3, lens_bh, seed, causal, scale,
                         float(dropout_rate))
         else:

@@ -41,6 +41,7 @@ from .arena import ArenaSpec, flatten, make_spec, unflatten
 
 
 from ._pallas_util import resolve_impl_streaming as _resolve
+from beforeholiday_tpu.guard.dispatch import checked_impl as _checked_impl
 
 
 def _nonfinite_any(x) -> jax.Array:
@@ -127,6 +128,9 @@ def multi_tensor_scale(
     impl = _resolve(impl)
     flat, spec = flatten(src)
     out_dtype = out_dtype or flat.dtype
+    # guarded dispatch: the streaming family defaults to jnp, so a pallas
+    # request is config-level (optimizer impl=) — degrade it gracefully too
+    impl = _checked_impl("multi_tensor_scale", impl, k.scale, flat, scale, out_dtype)
     if impl == "pallas":
         out, flag = k.scale(flat, scale, out_dtype)
     else:
@@ -156,6 +160,10 @@ def multi_tensor_axpby(
     xf, spec = flatten(x)
     yf, _ = flatten(y)
     out_dtype = out_dtype or xf.dtype
+    impl = _checked_impl(
+        "multi_tensor_axpby", impl, k.axpby, xf, yf, a, b, out_dtype,
+        arg_to_check=arg_to_check,
+    )
     if impl == "pallas":
         out, flag = k.axpby(xf, yf, a, b, out_dtype, arg_to_check=arg_to_check)
     else:
@@ -184,6 +192,7 @@ def multi_tensor_l2norm(
     """Global (and optionally per-tensor) L2 norm of a tensor list."""
     impl = _resolve(impl)
     flat, spec = flatten(tensors)
+    impl = _checked_impl("multi_tensor_l2norm", impl, k.l2norm_sq, flat)
     if impl == "pallas":
         sq, _ = k.l2norm_sq(flat)
     else:
@@ -239,6 +248,14 @@ def adam_flat(
     """
     impl = _resolve(impl)
     bc1, bc2 = _bias_corrections(bias_correction, step, beta1, beta2)
+    impl = _checked_impl(
+        "multi_tensor_adam", impl, k.adam, gf, pf, mf, vf,
+        lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+        bias_correction1=bc1, bias_correction2=bc2,
+        weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+        grad_scale=grad_scale, found_inf=found_inf,
+        model_copy_dtype=model_copy_dtype,
+    )
     if impl == "pallas":
         return k.adam(
             gf, pf, mf, vf,
@@ -320,6 +337,11 @@ def multi_tensor_adagrad(
     gf, spec = flatten(grads)
     pf, _ = flatten(params)
     hf, _ = flatten(state_sums)
+    impl = _checked_impl(
+        "multi_tensor_adagrad", impl, k.adagrad, gf, pf, hf,
+        lr=lr, eps=eps, weight_decay=weight_decay, mode=mode,
+        found_inf=found_inf,
+    )
     if impl == "pallas":
         p_new, h_new = k.adagrad(
             gf, pf, hf, lr=lr, eps=eps, weight_decay=weight_decay, mode=mode,
@@ -356,6 +378,13 @@ def sgd_flat(
     """Fused SGD over pre-flattened arenas (see :func:`adam_flat` for why).
     Returns (params, momentums[, model_copy])."""
     impl = _resolve(impl)
+    impl = _checked_impl(
+        "multi_tensor_sgd", impl, k.sgd, gf, pf, mf,
+        lr=lr, weight_decay=weight_decay, momentum=momentum,
+        dampening=dampening, nesterov=nesterov, first_run=first_run,
+        wd_after_momentum=wd_after_momentum, scale=scale,
+        model_copy_dtype=model_copy_dtype, found_inf=found_inf,
+    )
     if impl == "pallas":
         return k.sgd(
             gf, pf, mf, lr=lr, weight_decay=weight_decay, momentum=momentum,
@@ -450,6 +479,11 @@ def multi_tensor_novograd(
     denom_pt = jnp.sqrt(v_new) / bc2 + eps
     denom = _segment_coef(denom_pt, spec)
 
+    impl = _checked_impl(
+        "multi_tensor_novograd", impl, k.novograd_ew, gf, pf, mf, denom,
+        beta1=beta1, beta3=beta3, bias_correction1=bc1, lr=lr,
+        weight_decay=weight_decay, mode=moment_mode, found_inf=found_inf,
+    )
     if impl == "pallas":
         p_new, m_new = k.novograd_ew(
             gf, pf, mf, denom, beta1=beta1, beta3=beta3, bias_correction1=bc1,
@@ -478,6 +512,26 @@ def multi_tensor_novograd(
 # ---------------------------------------------------------------------------------
 
 
+def _lamb_pallas_probe(
+    gf, pf, mf, vf, *, beta1, beta2, beta3, bias_correction1, bias_correction2,
+    eps, weight_decay, clipped_global_grad_norm, mode, found_inf,
+    model_copy_dtype,
+):
+    """Guard probe for the LAMB pallas path: both kernel launches (stage1 and
+    the trust-ratio application) must build for the verdict to pass."""
+    u, m_new, v_new = k.lamb_stage1(
+        gf, pf, mf, vf, beta1=beta1, beta2=beta2, beta3=beta3,
+        bias_correction1=bias_correction1, bias_correction2=bias_correction2,
+        eps=eps, weight_decay=weight_decay,
+        clipped_global_grad_norm=clipped_global_grad_norm, mode=mode,
+        found_inf=found_inf,
+    )
+    coef = jnp.zeros(pf.shape, jnp.float32)
+    return k.apply_scaled_update(
+        pf, u, coef, found_inf=found_inf, model_copy_dtype=model_copy_dtype
+    ), m_new, v_new
+
+
 def lamb_flat(
     gf, pf, mf, vf, spec: ArenaSpec, *, lr, beta1: float = 0.9,
     beta2: float = 0.999, eps: float = 1e-6, step=1, bias_correction: bool = True,
@@ -498,6 +552,13 @@ def lamb_flat(
         global_grad_norm > max_grad_norm, global_grad_norm / max_grad_norm, 1.0
     )
 
+    impl = _checked_impl(
+        "multi_tensor_lamb", impl, _lamb_pallas_probe, gf, pf, mf, vf,
+        beta1=beta1, beta2=beta2, beta3=beta3, bias_correction1=bc1,
+        bias_correction2=bc2, eps=eps, weight_decay=weight_decay,
+        clipped_global_grad_norm=clipped, mode=mode, found_inf=found_inf,
+        model_copy_dtype=model_copy_dtype,
+    )
     g32, p32 = gf.astype(jnp.float32), pf.astype(jnp.float32)
     if impl == "pallas":
         u, m_new, v_new = k.lamb_stage1(

@@ -29,6 +29,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from beforeholiday_tpu.guard.dispatch import checked_impl as _checked_impl
 from beforeholiday_tpu.ops._autocast import float_function
 from beforeholiday_tpu.ops._pallas_util import (
     interpret_default as _interpret_default,
@@ -258,7 +259,16 @@ def mixed_dtype_fused_rms_norm(
     return _norm_impl(x, weight, None, eps, rms=True, out_dtype=weight.dtype, impl=impl)
 
 
+def _probe_ln_pallas(x2d, w, b, *, eps, rms, out_dtype):
+    """Guard probe: both passes of the norm kernel must build for the key."""
+    interp = _interpret_default()
+    y = _ln_fwd_pallas(x2d, w, b, eps, rms, out_dtype, interp)
+    _ln_bwd_pallas(x2d, w, jnp.zeros(x2d.shape, out_dtype), eps, rms, interp)
+    return y
+
+
 def _norm_impl(x, weight, bias, eps, rms, out_dtype, impl):
+    requested = impl
     impl = _resolve_impl(impl)
     hidden = x.shape[-1]
     if weight.shape != (hidden,):
@@ -269,5 +279,12 @@ def _norm_impl(x, weight, bias, eps, rms, out_dtype, impl):
     if bias is None:
         # fixed VJP arity: a zero bias whose cotangent is simply discarded
         bias = jnp.zeros((hidden,), weight.dtype)
+    if requested is None:
+        # default-on dispatch is guarded; an explicit impl= keeps the
+        # honor-the-request contract (including its exceptions) untouched
+        impl = _checked_impl(
+            "layer_norm", impl, _probe_ln_pallas, x2d, weight, bias,
+            eps=float(eps), rms=rms, out_dtype=jnp.dtype(out_dtype),
+        )
     y = _layer_norm(x2d, weight, bias, float(eps), rms, jnp.dtype(out_dtype), impl)
     return y.reshape(x.shape)

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from beforeholiday_tpu.guard.dispatch import checked_impl as _checked_impl
 from beforeholiday_tpu.ops._pallas_util import (
     interpret_default as _interpret_default,
     pad_rows as _pad_rows_util,
@@ -152,11 +153,32 @@ _softmax2d.defvjp(_softmax2d_fwd, _softmax2d_bwd)
 # ---------------------------------------------------------------------------------
 
 
+def _probe_softmax_pallas(x2d, *, scale, causal, sq):
+    """Guard probe: both softmax kernels must build for the key."""
+    interp = _interpret_default()
+    y = _fwd_pallas(x2d, scale, causal, sq, x2d.dtype, interp)
+    return _bwd_pallas(y, jnp.zeros(x2d.shape, x2d.dtype), scale, interp)
+
+
+def _guarded(requested, impl, x2d, scale, causal, sq):
+    """Guard only default-on dispatch; explicit ``impl=`` keeps the
+    honor-the-request contract untouched."""
+    if requested is not None:
+        return impl
+    return _checked_impl(
+        "softmax", impl, _probe_softmax_pallas, x2d,
+        scale=scale, causal=causal, sq=sq,
+    )
+
+
 def scaled_softmax(x: jax.Array, scale: float = 1.0, *, impl: Optional[str] = None):
     """softmax(scale*x) over the last dim (ref: scaled_softmax_cuda)."""
+    requested = impl
     impl = _resolve_impl(impl)
     sk = x.shape[-1]
-    y = _softmax2d(x.reshape(-1, sk), float(scale), False, 0, impl)
+    x2d = x.reshape(-1, sk)
+    impl = _guarded(requested, impl, x2d, float(scale), False, 0)
+    y = _softmax2d(x2d, float(scale), False, 0, impl)
     return y.reshape(x.shape)
 
 
@@ -170,10 +192,13 @@ def scaled_masked_softmax(
     happens outside the kernel so XLA fuses the head-broadcast — the mask is
     streamed once per (b, sq, sk), never materialized per head.
     """
+    requested = impl
     impl = _resolve_impl(impl)
     sk = x.shape[-1]
     filled = jnp.where(mask != 0, _MASK_VALUE, x.astype(jnp.float32) * scale)
-    y = _softmax2d(filled.reshape(-1, sk), 1.0, False, 0, impl)
+    x2d = filled.reshape(-1, sk)
+    impl = _guarded(requested, impl, x2d, 1.0, False, 0)
+    y = _softmax2d(x2d, 1.0, False, 0, impl)
     return y.astype(x.dtype).reshape(x.shape)
 
 
@@ -201,6 +226,7 @@ def scaled_upper_triang_masked_softmax(
     x: (attn_batches, sq, sk) with sq == sk (self-attention scores). The causal
     mask is generated in-kernel from iota — no mask tensor traffic.
     """
+    requested = impl
     impl = _resolve_impl(impl)
     b, sq, sk = x.shape
     if sq != sk:
@@ -209,5 +235,7 @@ def scaled_upper_triang_masked_softmax(
         # tile rows must align with the sequence so program_id recovers the
         # absolute query index; fall back for ragged sizes
         impl = "jnp"
-    y = _softmax2d(x.reshape(-1, sk), float(scale), True, sq, impl)
+    x2d = x.reshape(-1, sk)
+    impl = _guarded(requested, impl, x2d, float(scale), True, sq)
+    y = _softmax2d(x2d, float(scale), True, sq, impl)
     return y.reshape(x.shape)

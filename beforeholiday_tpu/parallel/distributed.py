@@ -27,6 +27,28 @@ import jax.numpy as jnp
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
 
 
+def _axis_size(axis_name: str):
+    """``jax.lax.axis_size`` where it exists (jax >= 0.6); the psum-of-ones
+    identity on older jax — same value, and XLA folds it to a constant."""
+    size = getattr(jax.lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def _grad_fingerprint(grads: Any) -> jax.Array:
+    """Cheap per-rank summary of a grad pytree: stacked fp32 (sum, sumsq) per
+    leaf. Identical local grads => identical fingerprints; a perturbed or
+    corrupted rank disagrees with overwhelming probability."""
+    parts = []
+    for g in jax.tree_util.tree_leaves(grads):
+        g32 = g.astype(jnp.float32)
+        parts.append(jnp.stack([jnp.sum(g32), jnp.sum(g32 * g32)]))
+    if not parts:
+        return jnp.zeros((2,), jnp.float32)
+    return jnp.concatenate(parts)
+
+
 def reduce_gradients(
     grads: Any,
     *,
@@ -34,6 +56,7 @@ def reduce_gradients(
     gradient_average: bool = True,
     gradient_predivide_factor: Optional[float] = None,
     allreduce_always_fp32: bool = False,
+    check_consistency: bool = False,
 ) -> Any:
     """psum a gradient pytree over ``axis_name`` with apex's scaling options.
 
@@ -46,8 +69,29 @@ def reduce_gradients(
     the axis size.
     Semantics match allreduce_fallback (ref: apex/parallel/distributed.py:316-349):
     predivide by f, allreduce, postdivide by world/f when averaging.
+
+    ``check_consistency=True`` changes the return to ``(reduced, mismatch)``:
+    ``mismatch`` is a traced bool, True when any rank's pre-reduce grad
+    fingerprint (per-leaf fp32 sum/sumsq) disagrees across the axis or is
+    non-finite — the silent-corruption tripwire for replicated-grad training
+    (a rank whose grads diverged poisons everyone through the psum). It costs
+    one pmax+pmin of a tiny vector; feed it into a skip/alarm path, it never
+    raises. NOTE: only meaningful when every rank is expected to hold the SAME
+    grads pre-reduce (replicated-batch debugging / overfit checks), not for
+    ordinary data-parallel steps where per-rank grads legitimately differ.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
+
+    mismatch = None
+    if check_consistency:
+        fp = _grad_fingerprint(grads)
+        hi = jax.lax.pmax(fp, axis_name)
+        lo = jax.lax.pmin(fp, axis_name)
+        # the non-finite test is rank-LOCAL (pmax may drop a lone NaN under
+        # maxNum semantics), so the combined flag gets its own reduction —
+        # every rank must return the same verdict
+        local_bad = jnp.any(hi != lo) | jnp.any(~jnp.isfinite(fp))
+        mismatch = jax.lax.pmax(local_bad.astype(jnp.int32), axis_name) > 0
 
     def _reduce(g):
         orig_dtype = g.dtype
@@ -65,7 +109,10 @@ def reduce_gradients(
             g = g.astype(orig_dtype)
         return g
 
-    return jax.tree.map(_reduce, grads)
+    reduced = jax.tree.map(_reduce, grads)
+    if check_consistency:
+        return reduced, mismatch
+    return reduced
 
 
 class Reducer:

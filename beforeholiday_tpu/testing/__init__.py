@@ -7,4 +7,5 @@ the same role: self-contained models used by the test suite, the benchmark
 driver, and ``__graft_entry__``.
 """
 
+from beforeholiday_tpu.testing import faults  # noqa: F401
 from beforeholiday_tpu.testing import gpt  # noqa: F401

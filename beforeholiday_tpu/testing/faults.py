@@ -1,0 +1,115 @@
+"""Deterministic, seedable fault injectors for the guardrail test-suite.
+
+Every guardrail in :mod:`beforeholiday_tpu.guard` must be exercisable under
+``JAX_PLATFORMS=cpu`` tier-1 tests; these injectors produce the faults. All are
+deterministic given their ``seed`` (leaf selection happens host-side with a
+private :class:`random.Random`, so injection sites are static under jit and the
+same seed always poisons the same leaves).
+
+* :func:`poison_grads`       — NaN/Inf N leaves of a grad pytree (the overflow
+  the amp sentinel must catch);
+* :func:`force_probe_failure` — make guarded dispatch's probe fail for an op
+  (the kernel-build failure the jnp degradation must absorb);
+* :func:`perturb_rank_grads` — perturb ONE rank's grads inside ``shard_map``
+  (the silent divergence ``reduce_gradients(check_consistency=True)`` must
+  flag).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+from typing import Any, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def poison_grads(
+    grads: Any,
+    *,
+    n: int = 1,
+    value: float = float("nan"),
+    seed: int = 0,
+    whole_leaf: bool = False,
+) -> Any:
+    """Return ``grads`` with ``n`` inexact leaves poisoned by ``value``.
+
+    By default one element per chosen leaf is poisoned — enough to trip any
+    correct non-finite sentinel while keeping the fault realistic (a single
+    overflowed activation, not a wiped tensor); ``whole_leaf=True`` floods the
+    leaf. Plugs directly into the ``reduce_grads`` hook of
+    ``scaled_value_and_grad`` / ``StepGuard.value_and_grad``.
+    """
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    candidates = [
+        i for i, l in enumerate(leaves)
+        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+    ]
+    if not candidates:
+        raise ValueError("no inexact leaves to poison")
+    picks = random.Random(seed).sample(candidates, min(n, len(candidates)))
+    for i in picks:
+        leaf = jnp.asarray(leaves[i])
+        if whole_leaf:
+            leaves[i] = jnp.full_like(leaf, value)
+        else:
+            flat = jnp.ravel(leaf).at[0].set(value)
+            leaves[i] = flat.reshape(leaf.shape)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@contextlib.contextmanager
+def force_probe_failure(*op_names: str) -> Iterator[None]:
+    """Force guarded dispatch's probe to fail for ``op_names`` in this scope.
+
+    Cached verdicts for the ops are dropped on entry (so an earlier clean probe
+    cannot mask the injection) AND on exit (so the forced failure does not
+    outlive the scope as a cached degradation).
+    """
+    from beforeholiday_tpu.guard import dispatch
+
+    if not op_names:
+        raise ValueError("force_probe_failure needs at least one op name")
+    added = [op for op in op_names if op not in dispatch._FORCED_FAILURES]
+    for op in op_names:
+        dispatch.clear_probe_cache(op)
+        dispatch._FORCED_FAILURES.add(op)
+    try:
+        yield
+    finally:
+        for op in added:
+            dispatch._FORCED_FAILURES.discard(op)
+        for op in op_names:
+            dispatch.clear_probe_cache(op)
+
+
+def perturb_rank_grads(
+    grads: Any,
+    axis_name: str,
+    rank: int = 0,
+    *,
+    eps: float = 1e-3,
+    value: Optional[float] = None,
+) -> Any:
+    """Inside ``shard_map``: corrupt ONE rank's inexact grad leaves.
+
+    Default adds ``eps`` (a realistic silent divergence — e.g. a rank that
+    dropped a microbatch); ``value=`` overwrites instead (e.g. ``float('nan')``
+    for a rank whose backward blew up). Other ranks pass through untouched, so
+    a consistency fingerprint across ``axis_name`` must disagree.
+    """
+    idx = jax.lax.axis_index(axis_name)
+
+    def _corrupt(g):
+        g = jnp.asarray(g)
+        if not jnp.issubdtype(g.dtype, jnp.inexact):
+            return g
+        bad = jnp.full_like(g, value) if value is not None else g + jnp.asarray(
+            eps, g.dtype
+        )
+        return jnp.where(idx == rank, bad, g)
+
+    return jax.tree_util.tree_map(_corrupt, grads)

@@ -24,6 +24,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax >= 0.6 spells manual mode jax.shard_map(check_vma=False); older jax has
+# the experimental module with check_rep — accept either
+if hasattr(jax, "shard_map"):
+    _shard_map = functools.partial(jax.shard_map, check_vma=False)
+else:
+    from jax.experimental.shard_map import shard_map as _esm
+
+    _shard_map = functools.partial(_esm, check_rep=False)
+
 from beforeholiday_tpu import amp
 from beforeholiday_tpu.optimizers import FusedSGD
 from beforeholiday_tpu.parallel import DistributedDataParallel
@@ -61,10 +70,9 @@ def main():
 
     @jax.jit
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        _shard_map, mesh=mesh,
         in_specs=(P(), P(), P("data"), P("data")),
         out_specs=(P(), P(), P()),
-        check_vma=False,
     )
     def train_step(state, scaler_state, x, y):
         p, opt_state = state

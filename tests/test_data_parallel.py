@@ -16,13 +16,24 @@ import pytest
 import torch
 from jax.sharding import Mesh, PartitionSpec as P
 
-# DDP semantics require local (unreduced) grads — the check_vma=False mode of
-# jax.shard_map (see beforeholiday_tpu/parallel/distributed.py docstring)
+# DDP semantics require local (unreduced) grads — varying-axis tracking off
+# (see beforeholiday_tpu/parallel/distributed.py docstring). jax >= 0.6 spells
+# that jax.shard_map(check_vma=False); older jax has the experimental module
+# with check_rep — support both so the suite runs on either.
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is not None:
+    _CHECK_KW = "check_vma"
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
 def shard_map(f=None, **kw):
-    kw.setdefault("check_vma", False)
+    kw.setdefault(_CHECK_KW, False)
     if f is None:
-        return lambda g: jax.shard_map(g, **kw)
-    return jax.shard_map(f, **kw)
+        return lambda g: _shard_map(g, **kw)
+    return _shard_map(f, **kw)
 
 from beforeholiday_tpu.optimizers import FusedSGD
 from beforeholiday_tpu.parallel import (
@@ -112,6 +123,32 @@ class TestReduceGradients:
         out = jax.jit(f)(g)
         assert out.dtype == jnp.bfloat16
 
+    def test_fp32_allreduce_composes_with_predivide(self, data_mesh):
+        """allreduce_always_fp32 + gradient_predivide_factor together: the
+        /f -> psum -> /(world/f) chain runs in fp32 and round-trips to the
+        input dtype, and the result still equals the plain average (ref:
+        apex/parallel/distributed.py:316-349 allreduce_fallback, which
+        applies both options in exactly this order)."""
+        vals = np.linspace(-3.0, 4.0, 8).astype(np.float32)
+
+        @functools.partial(
+            shard_map, mesh=data_mesh, in_specs=(P("data"),), out_specs=P("data")
+        )
+        def f(g):
+            return reduce_gradients(
+                {"g": g},
+                allreduce_always_fp32=True,
+                gradient_predivide_factor=4.0,
+            )["g"]
+
+        g16 = jnp.asarray(vals, jnp.bfloat16)
+        out = jax.jit(f)(g16)
+        assert out.dtype == jnp.bfloat16
+        want = jnp.asarray(vals, jnp.bfloat16).astype(jnp.float32).mean()
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), float(want), rtol=1e-2
+        )
+
     def test_ddp_training_identical_to_single_device(self, data_mesh):
         """Several optimizer steps: DP on 8 shards == single device, bitwise-ish."""
         rng = np.random.RandomState(1)
@@ -170,6 +207,27 @@ class TestReduceGradients:
         diverged = jnp.arange(8, dtype=jnp.float32) * 3.0 + 7.0  # rank i holds 3i+7
         out = np.asarray(jax.jit(f)(diverged))
         np.testing.assert_allclose(out, np.full(8, 7.0), atol=0)
+
+    def test_broadcast_params_integer_leaves_exact(self, data_mesh):
+        """Integer leaves (step counters, embeddings' index tables) broadcast
+        exactly — the masked-psum trick must neither promote the dtype nor
+        round the values, even when ranks disagree."""
+        r = Reducer()
+
+        @functools.partial(
+            shard_map, mesh=data_mesh,
+            in_specs=(P("data"), P("data")), out_specs=(P("data"), P("data")),
+        )
+        def f(w, step):
+            out = r.broadcast_params({"w": w, "step": step})
+            return out["w"], out["step"]
+
+        w = jnp.arange(8, dtype=jnp.float32) * 2.0 - 5.0  # rank i holds 2i-5
+        step = jnp.arange(8, dtype=jnp.int32) + 100       # rank i holds 100+i
+        ow, ostep = jax.jit(f)(w, step)
+        assert ostep.dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(ow), np.full(8, -5.0))
+        np.testing.assert_array_equal(np.asarray(ostep), np.full(8, 100))
 
 
 class TestSyncBatchNorm:

@@ -1,0 +1,117 @@
+"""Static no-host-sync check over the library source.
+
+The reference's scaler deliberately defers its only ``.item()`` to scale-update
+time (apex/amp/scaler.py:206); the TPU port goes further — NOTHING in the hot
+path may read a traced value back to the host, or every step stalls the XLA
+pipeline. This test walks the AST of every ``beforeholiday_tpu`` module and
+flags the two readback idioms:
+
+* any ``x.item()`` call;
+* ``float(...)`` / ``int(...)`` whose argument is a subscript like
+  ``state["scale"]`` — the traced-state readback pattern (a subscripted name is
+  how device state travels here; ``float(eps)`` on a plain config scalar is
+  fine and not flagged).
+
+Sanctioned sync points are ``state_dict``-family methods (checkpointing is
+host-side by contract, ref: apex/amp/frontend.py:434-473) — anything inside a
+function whose name is in ``_SANCTIONED_FUNCS`` passes. Host-side harnesses
+(testing/, models/ input pipelines) are out of scope: they run between steps,
+not inside them.
+"""
+
+import ast
+import pathlib
+
+import beforeholiday_tpu
+
+_PKG_ROOT = pathlib.Path(beforeholiday_tpu.__file__).parent
+
+# functions that are host-side by contract
+_SANCTIONED_FUNCS = frozenset({"state_dict", "load_state_dict"})
+
+# directories that are host harnesses, not step code
+_SKIP_DIRS = frozenset({"testing", "models"})
+
+# file-scoped waivers for sync points that are part of a documented host-side
+# contract but live outside a state_dict method; keep this list SHORT and
+# justified — every entry is a reviewed exception, not an escape hatch
+_WAIVED = {
+    # (relative path, function name): reason
+    ("contrib/sparsity.py", "permutation_search"):
+        "pure-NumPy host-side channel-permutation search (the reference's "
+        "ASP search also runs on host, between steps) — no traced values",
+}
+
+
+def _flag_nodes(tree: ast.AST):
+    """Yield (node, idiom) for every host-sync idiom outside a sanctioned
+    function."""
+    # stack of enclosing function names, updated via a manual walk
+    out = []
+
+    def visit(node, func_stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func_stack = func_stack + [node.name]
+        if isinstance(node, ast.Call):
+            f = node.func
+            sanctioned = any(n in _SANCTIONED_FUNCS for n in func_stack)
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "item"
+                and not node.args
+                and not sanctioned
+            ):
+                out.append((node, ".item()", func_stack))
+            if (
+                isinstance(f, ast.Name)
+                and f.id in ("float", "int")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Subscript)
+                # x.shape[i] is a static Python int, never a traced value
+                and not (
+                    isinstance(node.args[0].value, ast.Attribute)
+                    and node.args[0].value.attr == "shape"
+                )
+                and not sanctioned
+            ):
+                out.append((node, f"{f.id}(<subscript>)", func_stack))
+        for child in ast.iter_child_nodes(node):
+            visit(child, func_stack)
+
+    visit(tree, [])
+    return out
+
+
+def test_no_host_sync_idioms_in_library():
+    offenders = []
+    for py in sorted(_PKG_ROOT.rglob("*.py")):
+        rel = py.relative_to(_PKG_ROOT)
+        if rel.parts and rel.parts[0] in _SKIP_DIRS:
+            continue
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node, idiom, func_stack in _flag_nodes(tree):
+            func = func_stack[-1] if func_stack else "<module>"
+            if (str(rel), func) in _WAIVED:
+                continue
+            offenders.append(f"{rel}:{node.lineno} {idiom} in {func}()")
+    assert not offenders, (
+        "host-sync idioms outside state_dict/load_state_dict "
+        "(wrap readbacks in a state_dict-family method, or add a reviewed "
+        "waiver):\n  " + "\n  ".join(offenders)
+    )
+
+
+def test_scanner_catches_the_idioms():
+    """The checker itself must actually fire on both idioms — guard the guard."""
+    src = (
+        "def hot(state):\n"
+        "    a = state['scale'].item()\n"
+        "    b = float(state['scale'])\n"
+        "    c = int(state['n'])\n"
+        "    d = float(3.5)  # plain scalar: fine\n"
+        "def state_dict(state):\n"
+        "    return {'scale': float(state['scale'])}  # sanctioned\n"
+    )
+    flags = _flag_nodes(ast.parse(src))
+    idioms = sorted(i for _, i, _ in flags)
+    assert idioms == [".item()", "float(<subscript>)", "int(<subscript>)"]
