@@ -25,6 +25,9 @@ capability surface of NVIDIA Apex (reference: /root/reference):
 - ``beforeholiday_tpu.guard``       — robustness layer: probe-guarded Pallas dispatch
   (degrade to the jnp oracle instead of raising) and the StepGuard device-side
   skip/rollback state machine generalizing the loss scaler.
+- ``beforeholiday_tpu.monitor``     — jit-safe observability: device-side metrics
+  pytree with psum cross-rank aggregation, single-readback MetricsLogger export,
+  trace spans/timers, and guard-dispatch counters.
 
 Unlike the reference, which grafts CUDA kernels onto PyTorch via monkey-patching,
 this framework is functional and mesh-first: precision policies are dtype policies
@@ -35,6 +38,7 @@ collective is a `jax.lax` collective over named mesh axes carried on ICI/DCN.
 from beforeholiday_tpu import amp
 from beforeholiday_tpu import fp16_utils
 from beforeholiday_tpu import guard
+from beforeholiday_tpu import monitor
 from beforeholiday_tpu import ops
 from beforeholiday_tpu import optimizers
 from beforeholiday_tpu import parallel
@@ -48,6 +52,7 @@ __all__ = [
     "amp",
     "fp16_utils",
     "guard",
+    "monitor",
     "ops",
     "optimizers",
     "parallel",

@@ -141,7 +141,7 @@ class AmpModel:
         if not self.scalers:
             self.scalers = (self.scaler,)
 
-    def state_dict(self, scaler_state) -> Dict[str, Any]:
+    def state_dict(self, scaler_state, metrics=None) -> Dict[str, Any]:
         """Scaler checkpoint (ref: apex/amp/frontend.py:434-452 amp.state_dict
         — one ``loss_scaler{i}`` entry per loss). ``scaler_state`` is the
         single state, or a sequence of per-loss states when num_losses > 1.
@@ -151,7 +151,12 @@ class AmpModel:
         embedded scaler serializes as ``loss_scaler{i}`` as before, and the
         health counters ride along as ``health{i}``. The rollback snapshot is
         deliberately NOT serialized (it is model-sized and re-seeded from the
-        checkpointed params via :meth:`StepGuard.load_state_dict`)."""
+        checkpointed params via :meth:`StepGuard.load_state_dict`).
+
+        ``metrics`` optionally takes the :mod:`beforeholiday_tpu.monitor`
+        ``Metrics`` pytree; it serializes under a single ``"monitor"`` entry
+        (EMAs and counters survive restarts). Old loaders ignore the extra
+        key, so checkpoints stay readable both ways."""
         states = (
             list(scaler_state)
             if isinstance(scaler_state, (list, tuple))
@@ -168,6 +173,12 @@ class AmpModel:
                 out[f"health{i}"] = {k: int(v) for k, v in st["health"].items()}
             else:
                 out[f"loss_scaler{i}"] = s.state_dict(st)
+        if metrics is not None:
+            out["monitor"] = {
+                k: (int(v) if jnp.issubdtype(jnp.asarray(v).dtype, jnp.integer)
+                    else float(v))
+                for k, v in metrics.items()
+            }
         return out
 
     def load_state_dict(self, state_dict):
@@ -188,6 +199,22 @@ class AmpModel:
             else:
                 out.append(sstate)
         return out[0] if len(out) == 1 else out
+
+    def load_metrics(self, state_dict, monitor=None):
+        """Restore the monitor ``Metrics`` pytree saved by
+        ``state_dict(..., metrics=...)``. Returns None for pre-monitor
+        checkpoints (no ``"monitor"`` entry) — callers fall back to
+        ``monitor.init()``. ``monitor`` defaults to a fresh
+        :class:`~beforeholiday_tpu.monitor.TrainMonitor`, whose
+        ``load_state_dict`` zero-fills missing keys and drops unknown ones,
+        so spec drift in either direction stays loadable."""
+        if "monitor" not in state_dict:
+            return None
+        if monitor is None:
+            from beforeholiday_tpu.monitor import TrainMonitor
+
+            monitor = TrainMonitor()
+        return monitor.load_state_dict(state_dict["monitor"])
 
 
 def initialize(

@@ -37,7 +37,7 @@ from typing import Any, Callable, Dict, Optional, Set, Tuple
 
 import jax
 
-from beforeholiday_tpu.utils.logging import get_logger
+from beforeholiday_tpu.utils.logging import get_logger, reset_warn_once, warn_once
 
 logger = get_logger(__name__)
 
@@ -46,6 +46,32 @@ _VERDICTS: Dict[Tuple, Optional[str]] = {}
 _VERDICTS_LOCK = threading.Lock()
 _FORCED_FAILURES: Set[str] = set()
 _PROBE_MODE = "auto"  # "auto" | "compile" | "trace" | "off"
+
+# key -> {"pallas": n, "jnp": n, "probes": n} — trace-time dispatch
+# telemetry (every checked_impl call counts under the impl it returned;
+# "probes" counts actual probe builds, so hits = total - probes). Guarded by
+# _VERDICTS_LOCK; queried via monitor.counters / dispatch_counters().
+_COUNTERS: Dict[Tuple, Dict[str, int]] = {}
+
+
+def _count(key: Tuple, outcome: str, probed: bool = False) -> None:
+    # caller holds _VERDICTS_LOCK
+    c = _COUNTERS.setdefault(key, {"pallas": 0, "jnp": 0, "probes": 0})
+    c[outcome] += 1
+    if probed:
+        c["probes"] += 1
+
+
+def dispatch_counters() -> Dict[Tuple, Dict[str, int]]:
+    """Snapshot of per-key dispatch counts: how many trace-time dispatches
+    took the pallas path vs degraded to jnp, and how many ran a probe."""
+    with _VERDICTS_LOCK:
+        return {k: dict(v) for k, v in _COUNTERS.items()}
+
+
+def reset_dispatch_counters() -> None:
+    with _VERDICTS_LOCK:
+        _COUNTERS.clear()
 
 
 class InjectedProbeFailure(RuntimeError):
@@ -62,13 +88,20 @@ def set_probe_mode(mode: str) -> str:
 
 
 def clear_probe_cache(op_name: Optional[str] = None) -> None:
-    """Drop cached verdicts (all, or one op's) — next call re-probes."""
+    """Drop cached verdicts (all, or one op's) — next call re-probes (and may
+    warn again: the matching warn_once keys are reset too). Dispatch counters
+    are cumulative telemetry and are NOT cleared; use
+    :func:`reset_dispatch_counters`."""
     with _VERDICTS_LOCK:
         if op_name is None:
+            dropped = list(_VERDICTS)
             _VERDICTS.clear()
         else:
-            for key in [k for k in _VERDICTS if k[0] == op_name]:
+            dropped = [k for k in _VERDICTS if k[0] == op_name]
+            for key in dropped:
                 del _VERDICTS[key]
+    for key in dropped:
+        reset_warn_once(("guard.dispatch",) + key)
 
 
 def probe_failures() -> Dict[Tuple, str]:
@@ -157,23 +190,27 @@ def checked_impl(
     )
     with _VERDICTS_LOCK:
         if key in _VERDICTS:
-            return "jnp" if _VERDICTS[key] is not None else "pallas"
+            chosen = "jnp" if _VERDICTS[key] is not None else "pallas"
+            _count(key, chosen)
+            return chosen
     try:
         _probe(op_name, fn, args, kw)
     except Exception as e:  # noqa: BLE001 — degradation IS the contract
         summary = f"{type(e).__name__}: {e}"
-        fresh = False
         with _VERDICTS_LOCK:
-            if key not in _VERDICTS:
-                _VERDICTS[key] = summary
-                fresh = True
-        if fresh:
-            logger.warning(
-                "guarded dispatch: op=%s key=%s probe failed (%s); "
-                "degrading to the jnp oracle for this key",
-                op_name, key[2], summary,
-            )
+            _VERDICTS.setdefault(key, summary)
+            _count(key, "jnp", probed=True)
+        # warn_once dedups per key (clear_probe_cache resets it with the
+        # verdict, so a re-probe of the same key may warn again)
+        warn_once(
+            ("guard.dispatch",) + key,
+            "guarded dispatch: op=%s key=%s probe failed (%s); "
+            "degrading to the jnp oracle for this key",
+            op_name, key[2], summary,
+            logger=logger,
+        )
         return "jnp"
     with _VERDICTS_LOCK:
         _VERDICTS.setdefault(key, None)
+        _count(key, "pallas", probed=True)
     return "pallas"

@@ -1,0 +1,54 @@
+"""jit-safe training observability.
+
+Four pieces, split by which side of the device boundary they live on:
+
+* :mod:`beforeholiday_tpu.monitor.metrics`  — ``TrainMonitor`` + the
+  ``Metrics`` pytree: device-side counters/gauges/EMAs updated with pure jnp
+  inside the jitted step, with a ``lax.psum``-based cross-rank ``aggregate``.
+* :mod:`beforeholiday_tpu.monitor.export`   — ``MetricsLogger``: host-side
+  drain at a configurable cadence, one readback per logged step (JSONL / CSV
+  / callback).
+* :mod:`beforeholiday_tpu.monitor.spans`    — trace spans and wall-clock
+  timers (the former ``utils/timers.py`` + ``utils/profiling.py``, which
+  remain as re-export shims).
+* :mod:`beforeholiday_tpu.monitor.counters` — queryable guard-dispatch
+  hit/degrade counters.
+"""
+
+from beforeholiday_tpu.monitor.spans import (  # noqa: F401
+    Timers,
+    annotate,
+    nvtx_range,
+    span,
+    start_trace,
+    stop_trace,
+    trace,
+)
+from beforeholiday_tpu.monitor.metrics import (  # noqa: F401
+    Metrics,
+    TrainMonitor,
+    global_norm,
+)
+from beforeholiday_tpu.monitor.export import MetricsLogger  # noqa: F401
+from beforeholiday_tpu.monitor.counters import (  # noqa: F401
+    dispatch_counters,
+    dispatch_summary,
+    reset_dispatch_counters,
+)
+
+__all__ = [
+    "Metrics",
+    "MetricsLogger",
+    "Timers",
+    "TrainMonitor",
+    "annotate",
+    "dispatch_counters",
+    "dispatch_summary",
+    "global_norm",
+    "nvtx_range",
+    "reset_dispatch_counters",
+    "span",
+    "start_trace",
+    "stop_trace",
+    "trace",
+]

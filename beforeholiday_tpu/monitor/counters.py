@@ -1,0 +1,63 @@
+"""Queryable guard-dispatch counters — the monitor-side window onto
+``guard/dispatch.py``'s probe cache.
+
+Every ``checked_impl`` call is trace-time dispatch telemetry: did this
+(op, backend, shapes/dtypes, statics) key take the pallas kernel or degrade
+to the jnp oracle, and was a probe actually built? The raw counts live in
+``guard.dispatch`` (under its verdict lock); this module shapes them for
+operators — per-key rows plus an op-level rollup suitable for a bench JSON
+line or a health dashboard.
+
+Imports of ``guard.dispatch`` are deferred into the functions: the package
+import chain (utils → monitor.spans → monitor/__init__ → here) must not
+re-enter ``guard`` mid-import.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+__all__ = [
+    "dispatch_counters",
+    "dispatch_summary",
+    "reset_dispatch_counters",
+]
+
+
+def dispatch_counters() -> Dict[Tuple, Dict[str, int]]:
+    """Per-key snapshot: ``{key: {"pallas": n, "jnp": n, "probes": n}}``.
+    ``pallas``/``jnp`` count trace-time dispatches by chosen impl; ``probes``
+    counts actual probe builds (so cache hits = pallas + jnp - probes)."""
+    from beforeholiday_tpu.guard import dispatch as _dispatch
+
+    return _dispatch.dispatch_counters()
+
+
+def reset_dispatch_counters() -> None:
+    from beforeholiday_tpu.guard import dispatch as _dispatch
+
+    _dispatch.reset_dispatch_counters()
+
+
+def dispatch_summary() -> List[Dict[str, object]]:
+    """Op-level rollup, one JSON-ready row per op name:
+    ``{"op", "keys", "pallas", "jnp", "probes", "degraded_keys"}`` — the
+    shape ``bench.py`` embeds in its emitted line."""
+    from beforeholiday_tpu.guard import dispatch as _dispatch
+
+    per_key = _dispatch.dispatch_counters()
+    failed = set(_dispatch.probe_failures())
+    by_op: Dict[str, Dict[str, object]] = {}
+    for key, c in per_key.items():
+        row = by_op.setdefault(
+            key[0],
+            {"op": key[0], "keys": 0, "pallas": 0, "jnp": 0, "probes": 0,
+             "degraded_keys": 0},
+        )
+        row["keys"] += 1
+        row["pallas"] += c["pallas"]
+        row["jnp"] += c["jnp"]
+        row["probes"] += c["probes"]
+        if key in failed:
+            row["degraded_keys"] += 1
+    return sorted(by_op.values(), key=lambda r: r["op"])

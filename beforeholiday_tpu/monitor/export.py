@@ -1,0 +1,157 @@
+"""Host-side metrics export — the ONE sanctioned readback per logged step.
+
+The contract (enforced by ``tests/test_no_host_sync.py`` and the
+one-readback-per-step test in ``tests/test_monitor.py``):
+
+* the jitted step returns the packed metrics vector (``TrainMonitor.pack``)
+  alongside its outputs — one extra *output*, zero extra syncs;
+* ``MetricsLogger.log`` is called every step but only touches the host on
+  the configured cadence (``every``); off-cadence steps cost nothing;
+* on-cadence, ``drain`` fetches that single vector (ONE device→host
+  transfer — the same budget the bare training loop already spends reading
+  its loss scalar) and fans it out to JSONL/CSV writers and a callback.
+
+Everything device-side lives in ``monitor/metrics.py``; this module is the
+only place in ``monitor/`` allowed to perform readbacks (allowlisted by the
+AST no-host-sync check as ``drain``/``flush``/``_fetch``).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import numpy as np
+
+from beforeholiday_tpu.monitor.metrics import Metrics, TrainMonitor
+from beforeholiday_tpu.utils.logging import get_logger, warn_once
+
+logger = get_logger(__name__)
+
+Row = Dict[str, Union[int, float]]
+
+
+class MetricsLogger:
+    """Drain the metrics pytree at a configurable cadence.
+
+    Parameters
+    ----------
+    monitor: the ``TrainMonitor`` whose pack order defines the row schema.
+    path: optional output file; format chosen by ``fmt`` ("jsonl" | "csv").
+    every: cadence in steps — ``log`` drains on ``step % every == 0`` and is
+        a no-op (not even a fetch) otherwise.
+    callback: optional ``fn(step, row)`` hook invoked per drained row.
+    warn_overflow_streak: emit a (rate-limited, once per incident) warning
+        when the drained ``consecutive_overflows`` reaches this value;
+        ``0`` disables.
+    """
+
+    def __init__(
+        self,
+        monitor: TrainMonitor,
+        *,
+        path: Optional[str] = None,
+        fmt: str = "jsonl",
+        every: int = 1,
+        callback: Optional[Callable[[int, Row], None]] = None,
+        warn_overflow_streak: int = 3,
+    ):
+        assert fmt in ("jsonl", "csv"), f"unknown fmt {fmt!r}"
+        assert every >= 1, "every must be >= 1"
+        self.monitor = monitor
+        self.path = path
+        self.fmt = fmt
+        self.every = int(every)
+        self.callback = callback
+        self.warn_overflow_streak = int(warn_overflow_streak)
+        self.rows_written = 0
+        self._file = None
+        self._csv_writer = None
+        self._overflow_incident = 0
+        self._in_overflow = False
+
+    # ------------------------------------------------------------- readback
+    def _fetch(self, packed: jax.Array) -> np.ndarray:
+        """THE device→host transfer. Exactly one call per drained step —
+        tests subclass/wrap this to count syncs."""
+        return np.asarray(jax.device_get(packed))
+
+    def log(self, metrics: Union[Metrics, jax.Array], step: int) -> Optional[Row]:
+        """Per-step entry point. Off-cadence: returns None without touching
+        the device. On-cadence: drains and returns the row."""
+        if step % self.every != 0:
+            return None
+        return self.drain(metrics, step)
+
+    def drain(self, metrics: Union[Metrics, jax.Array], step: int) -> Row:
+        """Fetch + decode + export one row. Accepts either the packed vector
+        (recommended — return it from the jitted step) or the metrics dict
+        (packed here first, still a single fetch)."""
+        packed = self.monitor.pack(metrics) if isinstance(metrics, dict) else metrics
+        row = self.monitor.unpack_host(self._fetch(packed))
+        row = {"step": int(step), **row}
+        self._write(row)
+        if self.callback is not None:
+            self.callback(int(step), row)
+        self._check_overflow_streak(row)
+        self.rows_written += 1
+        return row
+
+    # -------------------------------------------------------------- writers
+    def _write(self, row: Row) -> None:
+        if self.path is None:
+            return
+        if self._file is None:
+            self._file = open(self.path, "a")
+        if self.fmt == "jsonl":
+            self._file.write(json.dumps(row) + "\n")
+        else:
+            if self._csv_writer is None:
+                self._csv_writer = csv.DictWriter(
+                    self._file, fieldnames=list(row.keys())
+                )
+                if self._file.tell() == 0:
+                    self._csv_writer.writeheader()
+            self._csv_writer.writerow(row)
+
+    def flush(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+            self._csv_writer = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------- warnings
+    def _check_overflow_streak(self, row: Row) -> None:
+        """One warning per overflow *incident* (streak crossing the
+        threshold), routed through ``warn_once`` so a long streak drained
+        every step never spams."""
+        if self.warn_overflow_streak <= 0:
+            return
+        streak = row.get("consecutive_overflows", 0)
+        if streak >= self.warn_overflow_streak:
+            if not self._in_overflow:
+                self._in_overflow = True
+                self._overflow_incident += 1
+            warn_once(
+                ("monitor.overflow_streak", id(self), self._overflow_incident),
+                "loss-scaler overflow streak: %d consecutive skipped steps at "
+                "step %d (loss_scale=%s) — inputs or lr may be unstable",
+                int(streak),
+                row.get("step"),
+                row.get("loss_scale"),
+                logger=logger,
+            )
+        else:
+            self._in_overflow = False

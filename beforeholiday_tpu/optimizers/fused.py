@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor.spans import annotate
 from beforeholiday_tpu.ops import multi_tensor as mt
 from beforeholiday_tpu.ops.arena import (
     ArenaSpec,
@@ -197,6 +198,7 @@ class FusedAdam(_FusedOptimizer):
         z = jnp.zeros(leaf.shape, self.state_dtype)
         return {"exp_avg": z, "exp_avg_sq": z}
 
+    @annotate("fused_adam_step")
     def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
         lr = self.lr if lr is None else lr
         pleaves, treedef = jax.tree_util.tree_flatten(params)
@@ -228,6 +230,7 @@ class FusedAdam(_FusedOptimizer):
             "step": step_no,
         }
 
+    @annotate("fused_adam_step_flat")
     def step_flat(self, flat_params, flat_grads, state, *, spec=None,
                   found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None):
         lr = self.lr if lr is None else lr
@@ -276,6 +279,7 @@ class FusedSGD(_FusedOptimizer):
     def _init_leaf_state(self, leaf):
         return {"momentum_buffer": jnp.zeros(leaf.shape, self.state_dtype)}
 
+    @annotate("fused_sgd_step")
     def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
         lr = self.lr if lr is None else lr
         pleaves, treedef = jax.tree_util.tree_flatten(params)
@@ -301,6 +305,7 @@ class FusedSGD(_FusedOptimizer):
         unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
         return unflat(new_p), {"momentum_buffer": unflat(new_b), "step": step_no}
 
+    @annotate("fused_sgd_step_flat")
     def step_flat(self, flat_params, flat_grads, state, *, spec=None,
                   found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None):
         lr = self.lr if lr is None else lr
@@ -409,6 +414,7 @@ class FusedLAMB(_FusedOptimizer):
         z = jnp.zeros(leaf.shape, self.state_dtype)
         return {"exp_avg": z, "exp_avg_sq": z}
 
+    @annotate("fused_lamb_step")
     def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
         lr = self.lr if lr is None else lr
         pleaves, treedef = jax.tree_util.tree_flatten(params)
@@ -450,6 +456,7 @@ class FusedLAMB(_FusedOptimizer):
             "step": step_no,
         }
 
+    @annotate("fused_lamb_step_flat")
     def step_flat(self, flat_params, flat_grads, state, *, spec=None,
                   found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None,
                   global_grad_norm=None):
@@ -671,6 +678,7 @@ class MasterWeights:
             inners.append(self.inner.init_flat(mf))
         return {"inner": tuple(inners), "master": tuple(masters)}
 
+    @annotate("master_weights_step")
     def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
         if isinstance(params, PackedParams):
             return self._step_packed(

@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor.spans import span
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
 
 
@@ -80,39 +81,40 @@ def reduce_gradients(
     grads pre-reduce (replicated-batch debugging / overfit checks), not for
     ordinary data-parallel steps where per-rank grads legitimately differ.
     """
-    world = _axis_size(axis_name)
+    with span("ddp_reduce_gradients"):
+        world = _axis_size(axis_name)
 
-    mismatch = None
-    if check_consistency:
-        fp = _grad_fingerprint(grads)
-        hi = jax.lax.pmax(fp, axis_name)
-        lo = jax.lax.pmin(fp, axis_name)
-        # the non-finite test is rank-LOCAL (pmax may drop a lone NaN under
-        # maxNum semantics), so the combined flag gets its own reduction —
-        # every rank must return the same verdict
-        local_bad = jnp.any(hi != lo) | jnp.any(~jnp.isfinite(fp))
-        mismatch = jax.lax.pmax(local_bad.astype(jnp.int32), axis_name) > 0
+        mismatch = None
+        if check_consistency:
+            fp = _grad_fingerprint(grads)
+            hi = jax.lax.pmax(fp, axis_name)
+            lo = jax.lax.pmin(fp, axis_name)
+            # the non-finite test is rank-LOCAL (pmax may drop a lone NaN under
+            # maxNum semantics), so the combined flag gets its own reduction —
+            # every rank must return the same verdict
+            local_bad = jnp.any(hi != lo) | jnp.any(~jnp.isfinite(fp))
+            mismatch = jax.lax.pmax(local_bad.astype(jnp.int32), axis_name) > 0
 
-    def _reduce(g):
-        orig_dtype = g.dtype
-        if allreduce_always_fp32:
-            g = g.astype(jnp.float32)
-        if gradient_predivide_factor is not None:
-            g = g / gradient_predivide_factor
-        g = jax.lax.psum(g, axis_name)
-        if gradient_average:
+        def _reduce(g):
+            orig_dtype = g.dtype
+            if allreduce_always_fp32:
+                g = g.astype(jnp.float32)
             if gradient_predivide_factor is not None:
-                g = g / (world / gradient_predivide_factor)
-            else:
-                g = g / world
-        if allreduce_always_fp32:
-            g = g.astype(orig_dtype)
-        return g
+                g = g / gradient_predivide_factor
+            g = jax.lax.psum(g, axis_name)
+            if gradient_average:
+                if gradient_predivide_factor is not None:
+                    g = g / (world / gradient_predivide_factor)
+                else:
+                    g = g / world
+            if allreduce_always_fp32:
+                g = g.astype(orig_dtype)
+            return g
 
-    reduced = jax.tree.map(_reduce, grads)
-    if check_consistency:
-        return reduced, mismatch
-    return reduced
+        reduced = jax.tree.map(_reduce, grads)
+        if check_consistency:
+            return reduced, mismatch
+        return reduced
 
 
 class Reducer:
@@ -132,13 +134,14 @@ class Reducer:
         psum — zero every rank's contribution except rank 0 — which is exact
         both when ranks have diverged (the repair scenario broadcast exists
         for) and when they are already replicated."""
-        is_src = jax.lax.axis_index(self.axis_name) == 0
-        return jax.tree.map(
-            lambda p: jax.lax.psum(
-                jnp.where(is_src, p, jnp.zeros((), p.dtype)), self.axis_name
-            ),
-            params,
-        )
+        with span("ddp_broadcast_params"):
+            is_src = jax.lax.axis_index(self.axis_name) == 0
+            return jax.tree.map(
+                lambda p: jax.lax.psum(
+                    jnp.where(is_src, p, jnp.zeros((), p.dtype)), self.axis_name
+                ),
+                params,
+            )
 
     def reduce(self, tree: Any, average: bool = True) -> Any:
         return reduce_gradients(

@@ -49,6 +49,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor.spans import span
 from beforeholiday_tpu.parallel.parallel_state import PIPE_AXIS
 from beforeholiday_tpu.transformer.pipeline_parallel import p2p_communication
 
@@ -207,7 +208,7 @@ def _pipelined_fwd_bwd(
         # peers along tensor/data/context axes take the same branch, so
         # stage_fn-internal collectives cannot diverge. stage_fn must not
         # carry PIPE-axis collectives (the rings below are the pipe traffic).
-        with jax.named_scope("pp_forward_slot"):
+        with span("pp_forward_slot"):
             f_valid, m_f, v_f, tf_f = decompose_f(t)
             sp_f = chunk_of(v_f)
             is_first_logical = is_first_dev & (v_f == 0)
@@ -279,7 +280,7 @@ def _pipelined_fwd_bwd(
                 jnp.zeros(hidden_shape, hidden_dtype),
             )
 
-        with jax.named_scope("pp_backward_slot"):
+        with span("pp_backward_slot"):
             mb_loss, dsp, dhp, dx = jax.lax.cond(
                 b_valid,
                 lambda: jax.lax.cond(is_last_logical, last_branch, inner_branch),
@@ -320,7 +321,7 @@ def _pipelined_fwd_bwd(
             g_embed = _acc_tree(g_embed, b_valid & is_first_logical_b, dep)
 
         # ---- rings ---------------------------------------------------------------
-        with jax.named_scope("pp_p2p_rings"):
+        with span("pp_p2p_rings"):
             fwd_reg, bwd_reg = p2p_communication.send_forward_recv_backward(
                 jnp.where(f_valid, y, 0.0).astype(hidden_dtype),
                 jnp.where(b_valid, dx, 0.0).astype(hidden_dtype),
@@ -536,7 +537,7 @@ def forward_backward_pipelining_encoder_decoder(
          loss_acc) = carry
 
         # ---- forward slot ---------------------------------------------------------
-        with jax.named_scope("ppT5_forward_slot"):
+        with span("ppT5_forward_slot"):
             u = t - rank
             f_valid = (u >= 0) & (u < M)
             m_f = jnp.clip(u, 0, M - 1)
@@ -589,7 +590,7 @@ def forward_backward_pipelining_encoder_decoder(
             return (jnp.float32(0.0), zeros_stage_g, zeros_head_g,
                     jnp.zeros(pair_shape, hidden_dtype))
 
-        with jax.named_scope("ppT5_backward_slot"):
+        with span("ppT5_backward_slot"):
             mb_loss, dsp, dhp, dx = jax.lax.cond(
                 b_valid,
                 lambda: jax.lax.cond(is_last_dev, last_branch, inner_branch),
@@ -637,7 +638,7 @@ def forward_backward_pipelining_encoder_decoder(
         )
 
         # ---- rings ---------------------------------------------------------------
-        with jax.named_scope("ppT5_p2p_rings"):
+        with span("ppT5_p2p_rings"):
             fwd_reg, bwd_reg = p2p_communication.send_forward_recv_backward(
                 jnp.where(f_valid, y, 0.0).astype(hidden_dtype),
                 jnp.where(b_valid, dx_ring, 0.0).astype(hidden_dtype),

@@ -1,7 +1,16 @@
-"""Shared utilities: logging, pytree helpers, timers, profiling."""
+"""Shared utilities: logging, pytree helpers; timers/profiling live in
+``beforeholiday_tpu.monitor`` now (re-exported here for back-compat)."""
 
-from beforeholiday_tpu.utils.logging import get_logger
+from beforeholiday_tpu.utils.logging import get_logger, reset_warn_once, warn_once
 from beforeholiday_tpu.utils.profiling import annotate, nvtx_range, trace
 from beforeholiday_tpu.utils.timers import Timers
 
-__all__ = ["get_logger", "Timers", "annotate", "nvtx_range", "trace"]
+__all__ = [
+    "get_logger",
+    "Timers",
+    "annotate",
+    "nvtx_range",
+    "reset_warn_once",
+    "trace",
+    "warn_once",
+]

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
+from typing import Hashable, Optional
 
 _LOG_ENV = "BEFOREHOLIDAY_TPU_LOG_LEVEL"
 
@@ -76,3 +78,42 @@ def get_logger(name: str = "beforeholiday_tpu") -> logging.Logger:
         logger.setLevel(level)
         logger.propagate = False
     return logger
+
+
+# ---------------------------------------------------------------- warn_once
+# Keyed rate limiting for warnings that fire from per-step or per-key code
+# paths (guard probe failures, scaler overflow streaks): the FIRST emission
+# per key goes through, repeats are swallowed. Process-global by design —
+# the point is that a key warns once per process, not once per call site.
+_WARNED: set = set()
+_WARNED_LOCK = threading.Lock()
+
+
+def warn_once(
+    key: Hashable,
+    msg: str,
+    *args,
+    logger: Optional[logging.Logger] = None,
+    level: int = logging.WARNING,
+) -> bool:
+    """Log ``msg % args`` at ``level`` the first time ``key`` is seen;
+    swallow repeats. Returns True iff the record was emitted. ``logger``
+    defaults to the package logger — pass the calling module's logger so the
+    record carries the right name (and so tests capturing that logger's
+    handlers still see it)."""
+    with _WARNED_LOCK:
+        if key in _WARNED:
+            return False
+        _WARNED.add(key)
+    (logger if logger is not None else get_logger()).log(level, msg, *args)
+    return True
+
+
+def reset_warn_once(key: Optional[Hashable] = None) -> None:
+    """Forget one key (or all, when ``key`` is None) so it may warn again —
+    cache-invalidation hook for callers like ``guard.clear_probe_cache``."""
+    with _WARNED_LOCK:
+        if key is None:
+            _WARNED.clear()
+        else:
+            _WARNED.discard(key)

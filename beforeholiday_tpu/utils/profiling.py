@@ -1,67 +1,17 @@
-"""Tracing/profiling hooks (ref: SURVEY §5 — the reference's NVTX ranges
-gated by ``prof`` in DDP, apex/parallel/distributed.py:360-361, and the
-cuda-sync'd ``_Timers``).
-
-TPU equivalents: ``jax.named_scope`` annotations (they surface in XProf /
-tensorboard traces the way NVTX ranges surface in nsight) plus thin wrappers
-over ``jax.profiler``'s trace collection. Annotations are zero-cost at
-runtime — they only label the HLO.
+"""Back-compat shim — the profiling hooks moved to
+:mod:`beforeholiday_tpu.monitor.spans` (the observability subsystem). Import
+from there in new code; this module re-exports the full original surface.
 """
 
 from __future__ import annotations
 
-import contextlib
-import functools
-from typing import Optional
+from beforeholiday_tpu.monitor.spans import (  # noqa: F401
+    annotate,
+    nvtx_range,
+    span,
+    start_trace,
+    stop_trace,
+    trace,
+)
 
-import jax
-
-__all__ = ["annotate", "nvtx_range", "start_trace", "stop_trace", "trace"]
-
-
-def annotate(name: str):
-    """Decorator: wrap a function's trace in a named scope (the NVTX-range
-    idiom, ref: distributed.py ``torch.cuda.nvtx.range_push``)."""
-
-    def deco(fn):
-        @functools.wraps(fn)
-        def wrapped(*args, **kwargs):
-            with jax.named_scope(name):
-                return fn(*args, **kwargs)
-
-        return wrapped
-
-    return deco
-
-
-@contextlib.contextmanager
-def nvtx_range(name: str, enabled: bool = True):
-    """Context-manager form, gated like the reference's ``prof`` flag."""
-    if enabled:
-        with jax.named_scope(name):
-            yield
-    else:
-        yield
-
-
-def start_trace(log_dir: str, **kw) -> None:
-    """Begin an XProf trace (view in tensorboard's profile tab)."""
-    jax.profiler.start_trace(log_dir, **kw)
-
-
-def stop_trace() -> None:
-    jax.profiler.stop_trace()
-
-
-@contextlib.contextmanager
-def trace(log_dir: Optional[str]):
-    """Trace the enclosed block when ``log_dir`` is set; no-op otherwise —
-    so trainers can take a ``--profile-dir`` flag and leave the call in."""
-    if log_dir:
-        jax.profiler.start_trace(log_dir)
-        try:
-            yield
-        finally:
-            jax.profiler.stop_trace()
-    else:
-        yield
+__all__ = ["annotate", "nvtx_range", "span", "start_trace", "stop_trace", "trace"]

@@ -1,73 +1,10 @@
-"""Wall-clock timers with device synchronization.
-
-Ref: apex/transformer/pipeline_parallel/_timers.py:83 ``_Timers`` — named
-start/stop timers that optionally ``torch.cuda.synchronize()``. The TPU analogue
-of the sync is ``jax.block_until_ready`` on a token array, and trace-level
-annotation is `jax.named_scope` / `jax.profiler` (SURVEY.md §5).
+"""Back-compat shim — the wall-clock timers moved to
+:mod:`beforeholiday_tpu.monitor.spans` (the observability subsystem). Import
+from there in new code; this module re-exports the full original surface.
 """
 
 from __future__ import annotations
 
-import time
-from typing import Dict
+from beforeholiday_tpu.monitor.spans import Timers, _Timer  # noqa: F401
 
-import jax
-
-
-class _Timer:
-    def __init__(self, name: str):
-        self.name = name
-        self._elapsed = 0.0
-        self._started = False
-        self._start_time = 0.0
-
-    def start(self, barrier_on=None):
-        assert not self._started, f"timer {self.name} already started"
-        if barrier_on is not None:
-            jax.block_until_ready(barrier_on)
-        self._start_time = time.perf_counter()
-        self._started = True
-
-    def stop(self, barrier_on=None):
-        assert self._started, f"timer {self.name} not started"
-        if barrier_on is not None:
-            jax.block_until_ready(barrier_on)
-        self._elapsed += time.perf_counter() - self._start_time
-        self._started = False
-
-    def reset(self):
-        self._elapsed = 0.0
-        self._started = False
-
-    def elapsed(self, reset: bool = True) -> float:
-        running = self._started
-        if running:
-            self.stop()
-        value = self._elapsed
-        if reset:
-            self.reset()
-        if running:
-            self.start()
-        return value
-
-
-class Timers:
-    """Group of named timers (ref: _timers.py:120 ``Timers``)."""
-
-    def __init__(self):
-        self._timers: Dict[str, _Timer] = {}
-
-    def __call__(self, name: str) -> _Timer:
-        if name not in self._timers:
-            self._timers[name] = _Timer(name)
-        return self._timers[name]
-
-    def log(self, names, normalizer: float = 1.0, reset: bool = True) -> str:
-        for name in names:
-            # a typo'd timer name must be loud, not silently dropped
-            assert name in self._timers, f"timer {name!r} was never started"
-        parts = [
-            f"{name}: {self._timers[name].elapsed(reset=reset) * 1000.0 / normalizer:.2f}ms"
-            for name in names
-        ]
-        return "time (ms) | " + " | ".join(parts)
+__all__ = ["Timers"]

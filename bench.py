@@ -720,6 +720,67 @@ def make_gpt_rung():
 
 
 # ---------------------------------------------------------------------------------
+# monitor substrate (observability overhead + metrics snapshot)
+# ---------------------------------------------------------------------------------
+
+
+def make_monitor_rungs():
+    """Identical toy train step with and without the monitor metrics fold —
+    prices the pure-jnp observability substrate (a handful of norm reductions
+    per step; the contract is zero extra host syncs, so the only cost is
+    device FLOPs). Returns (chains, TrainMonitor)."""
+    from beforeholiday_tpu.monitor import TrainMonitor
+
+    mon = TrainMonitor()
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    params = {
+        "w1": jax.random.normal(ks[0], (1024, 1024), jnp.float32) * 0.02,
+        "w2": jax.random.normal(ks[1], (1024, 1024), jnp.float32) * 0.02,
+    }
+    x = jax.random.normal(ks[2], (256, 1024), jnp.float32)
+    lr = 1e-3
+
+    def loss_fn(p, x):
+        h = jnp.tanh(x @ p["w1"])
+        return jnp.mean(jnp.square(h @ p["w2"]))
+
+    def plain_step(p, x):
+        _, g = jax.value_and_grad(loss_fn)(p, x)
+        return jax.tree.map(lambda a, b: a - lr * b, p, g)
+
+    def monitored_step(s, x):
+        p, m = s
+        loss, g = jax.value_and_grad(loss_fn)(p, x)
+        p2 = jax.tree.map(lambda a, b: a - lr * b, p, g)
+        m = mon.update(m, loss=loss, grads=g, params=p, new_params=p2)
+        return (p2, m)
+
+    chains = {
+        "plain": Chain(plain_step, params, (x,)).calibrate(0.6),
+        "monitored": Chain(
+            monitored_step, (params, mon.init()), (x,)
+        ).calibrate(0.6),
+    }
+    return chains, mon
+
+
+def _drain_metrics(mon, metrics):
+    """One-fetch drain of a metrics pytree into a JSON-ready row (no file,
+    no overflow warning — the bench only wants the values)."""
+    from beforeholiday_tpu.monitor import MetricsLogger
+
+    return MetricsLogger(mon, warn_overflow_streak=0).drain(metrics, step=0)
+
+
+def _monitor_snapshot(mon, chain, n=16):
+    """Advance the monitored chain ``n`` steps OUTSIDE timing and drain the
+    final metrics pytree — the emitted line carries real trajectory values
+    (loss/grad-norm EMAs after n steps), not init-state zeros."""
+    out = chain.run(jnp.int32(n), chain.state, *chain.inv)
+    return _drain_metrics(mon, out[1])
+
+
+# ---------------------------------------------------------------------------------
 # pipeline overhead (CPU-mesh proxy)
 # ---------------------------------------------------------------------------------
 
@@ -931,6 +992,22 @@ def main():
     ring = None
     _free()
 
+    # --- monitor substrate: overhead ratio + drained metrics snapshot ---
+    monr = _stage(detail, make_monitor_rungs)
+    if monr:
+        mchains, mon = monr
+        t1 = _round_robin(mchains, pairs=3)
+        t2 = _round_robin(mchains, pairs=2)
+        detail["monitor_overhead_vs_plain"] = round(
+            _sub_ratio(t1, "monitored", "plain"), 3)
+        pass2["monitor_overhead_vs_plain"] = _sub_ratio(t2, "monitored", "plain")
+        snap = _stage(detail, _monitor_snapshot, mon, mchains["monitored"])
+        if snap:
+            detail["monitor_metrics"] = snap
+        mchains = None
+    monr = None
+    _free()
+
     # --- PP overhead (CPU proxy, subprocess) ---
     pp_res = _stage(detail, bench_pp_overhead)
     if pp_res:
@@ -938,6 +1015,14 @@ def main():
             "pp_overhead_vs_sequential"]
         detail["pp_1f1b_ms_cpu8"] = pp_res["pp_1f1b_ms"]
         detail["pp_note"] = "schedule-logic proxy on an 8-CPU mesh, not a TPU number"
+
+    # --- guard dispatch counters: what every rung above actually dispatched
+    # (collected LAST so the telemetry covers the whole bench) ---
+    from beforeholiday_tpu.monitor import dispatch_summary
+
+    counters = _stage(detail, dispatch_summary)
+    if counters is not None:
+        detail["dispatch_counters"] = counters
 
     # --- stability gate: pass-2 must agree within 10% on every ratio ---
     unstable = _unstable_keys(detail, pass2)

@@ -74,3 +74,59 @@ class TestStabilityGate:
         detail = {"zero": 0.0, "ok": 1.0}
         pass2 = {"zero": 5.0, "missing": 9.0, "ok": float("nan")}
         assert bench._unstable_keys(detail, pass2) == []
+
+
+class TestMonitorExport:
+    """The observability entries in the emitted JSON line (ISSUE 2 satellite):
+    metrics snapshot + dispatch counters must come out JSON-clean."""
+
+    def test_drain_metrics_is_json_ready(self):
+        import json
+
+        from beforeholiday_tpu.monitor import TrainMonitor
+
+        mon = TrainMonitor()
+        m = mon.update(mon.init(), loss=jnp.float32(2.0),
+                       grads={"g": jnp.ones((3,))})
+        row = bench._drain_metrics(mon, m)
+        assert row["loss"] == 2.0 and row["steps"] == 1
+        json.dumps(row)  # every value a Python scalar, never a jax array
+
+    def test_monitor_snapshot_advances_the_chain(self):
+        from beforeholiday_tpu.monitor import TrainMonitor
+
+        mon = TrainMonitor()
+
+        def step(s):
+            p, m = s
+            g = {"w": p["w"] * 0.1}
+            p2 = {"w": p["w"] - g["w"]}
+            return p2, mon.update(
+                m, loss=jnp.sum(p["w"]), grads=g, params=p, new_params=p2)
+
+        c = bench.Chain(step, ({"w": jnp.ones((4,))}, mon.init()))
+        c.compile()
+        row = bench._monitor_snapshot(mon, c, n=5)
+        assert row["steps"] == 5
+        assert row["grad_norm"] > 0
+
+    def test_dispatch_summary_shape_matches_bench_embedding(self):
+        import json
+
+        from beforeholiday_tpu.guard import checked_impl, clear_probe_cache
+        from beforeholiday_tpu.monitor import (
+            dispatch_summary,
+            reset_dispatch_counters,
+        )
+
+        clear_probe_cache()
+        reset_dispatch_counters()
+        try:
+            checked_impl("bench_op", "pallas", lambda x: x, jnp.ones((2,)))
+            rows = dispatch_summary()
+            assert rows and set(rows[0]) == {
+                "op", "keys", "pallas", "jnp", "probes", "degraded_keys"}
+            json.dumps(rows)
+        finally:
+            clear_probe_cache()
+            reset_dispatch_counters()
