@@ -1,6 +1,6 @@
 """jit-safe training observability.
 
-Four pieces, split by which side of the device boundary they live on:
+Split by which side of the device boundary each piece lives on:
 
 * :mod:`beforeholiday_tpu.monitor.metrics`  — ``TrainMonitor`` + the
   ``Metrics`` pytree: device-side counters/gauges/EMAs updated with pure jnp
@@ -13,8 +13,25 @@ Four pieces, split by which side of the device boundary they live on:
   remain as re-export shims).
 * :mod:`beforeholiday_tpu.monitor.counters` — queryable guard-dispatch
   hit/degrade counters.
+* :mod:`beforeholiday_tpu.monitor.comms`    — trace-time collective-traffic
+  ledger (op kind / axis / dtype / bytes / call-site, subsystem rollup).
+* :mod:`beforeholiday_tpu.monitor.trace`    — host timeline recorder +
+  Chrome-trace/Perfetto ``trace.json`` exporter (``timeline``).
+* :mod:`beforeholiday_tpu.monitor.compile`  — recompile sentinel
+  (``track_compiles``: count signatures per jitted entry, warn on storms).
 """
 
+# NOTE on the name ``trace``: importing the ``monitor.trace`` SUBMODULE below
+# sets the package attribute ``trace`` to the module; the spans import after
+# it deliberately rebinds ``trace`` to the profiler context manager (the
+# pre-existing public name). Internal code reaches the submodule via the full
+# dotted path (``from beforeholiday_tpu.monitor.trace import ...``), which is
+# unaffected by the rebinding.
+from beforeholiday_tpu.monitor.trace import (  # noqa: F401
+    TraceRecorder,
+    active_recorder,
+    timeline,
+)
 from beforeholiday_tpu.monitor.spans import (  # noqa: F401
     Timers,
     annotate,
@@ -35,20 +52,43 @@ from beforeholiday_tpu.monitor.counters import (  # noqa: F401
     dispatch_summary,
     reset_dispatch_counters,
 )
+from beforeholiday_tpu.monitor.comms import (  # noqa: F401
+    comms_records,
+    comms_summary,
+    ledger_scope,
+    reset_comms_ledger,
+)
+from beforeholiday_tpu.monitor.compile import (  # noqa: F401
+    compile_counts,
+    compile_summary,
+    reset_compile_counts,
+    track_compiles,
+)
 
 __all__ = [
     "Metrics",
     "MetricsLogger",
     "Timers",
+    "TraceRecorder",
     "TrainMonitor",
+    "active_recorder",
     "annotate",
+    "comms_records",
+    "comms_summary",
+    "compile_counts",
+    "compile_summary",
     "dispatch_counters",
     "dispatch_summary",
     "global_norm",
+    "ledger_scope",
     "nvtx_range",
+    "reset_comms_ledger",
+    "reset_compile_counts",
     "reset_dispatch_counters",
     "span",
     "start_trace",
     "stop_trace",
+    "timeline",
     "trace",
+    "track_compiles",
 ]

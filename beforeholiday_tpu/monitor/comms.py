@@ -1,0 +1,228 @@
+"""Collective-traffic ledger — trace-time accounting of every collective the
+library issues.
+
+The reference answers "where do the bytes go" with NCCL debug logs and nsight
+timelines; under jit neither exists, but something better does: every
+``lax`` collective passes through Python exactly once per compilation, when
+the step is TRACED. Recording there costs ZERO device time and ZERO host
+syncs — the ledger is a host-side dict updated while XLA builds the program,
+never while it runs (``tests/test_no_host_sync.py`` proves the module adds no
+readback idioms).
+
+Contract — what a record means:
+
+* Each wrapper (``psum``/``pmax``/``pmin``/``all_gather``/``psum_scatter``/
+  ``ppermute``/``all_to_all``) records the op kind, axis name, dtype, the
+  PER-RANK local input payload bytes (``size * itemsize`` of the local
+  operand — the quantity each rank hands to the interconnect), and a
+  call-site tag, then delegates to the identical ``jax.lax`` op.
+* Accounting is PER TRACE: one compiled step records each collective once,
+  however many steps later execute from the cache. A collective inside a
+  ``lax.scan``/``fori_loop`` BODY records once but executes once per
+  iteration — multiply by the trip count when converting to wire bytes (the
+  ring-attention k/v permutes and the pipeline tick rings are the two such
+  sites here, both tagged so the caveat is findable).
+* ``ledger_scope`` pushes a caller label (e.g. the TP layer name) onto a
+  per-thread stack; records carry the joined stack, so mapping-level
+  collectives attribute to the layer that issued them.
+
+Query like ``dispatch_summary()``: ``comms_records()`` is the per-key
+snapshot, ``comms_summary()`` rolls up by subsystem (the site tag's prefix
+before the first ``.`` — ``ddp``/``tp``/``sp``/``pp``/``cp``/``zero2``/
+``sync_bn``), ``reset_comms_ledger()`` clears between entry points.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "all_gather",
+    "all_to_all",
+    "comms_records",
+    "comms_summary",
+    "ledger_scope",
+    "pmax",
+    "pmin",
+    "ppermute",
+    "psum",
+    "psum_scatter",
+    "record",
+    "reset_comms_ledger",
+]
+
+_LOCK = threading.Lock()
+# (kind, axis, dtype, site, scope) -> {"calls": n, "bytes": b}
+_RECORDS: Dict[Tuple[str, str, str, str, str], Dict[str, int]] = {}
+_TLS = threading.local()
+
+
+def _scope_stack() -> List[str]:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+@contextlib.contextmanager
+def ledger_scope(name: str):
+    """Label every collective recorded inside the block (nests; per-thread).
+    The TP/SP layers wrap their bodies so mapping-level collectives attribute
+    to ``column_parallel_linear`` etc. rather than to the shared helpers."""
+    st = _scope_stack()
+    st.append(name)
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def _payload_bytes(tree: Any) -> Dict[str, int]:
+    """Per-dtype local input payload bytes over the pytree's leaves. Works on
+    tracers (shape/dtype are static) and plain Python scalars."""
+    out: Dict[str, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dt = np.dtype(jnp.result_type(leaf))
+        n = math.prod(jnp.shape(leaf))
+        out[dt.name] = out.get(dt.name, 0) + n * dt.itemsize
+    return out
+
+
+def record(kind: str, axis_name: Any, tree: Any, *, site: str) -> None:
+    """Account one collective call (host-side, trace-time). Wrappers call
+    this; call it directly only for a collective with no wrapper here."""
+    scope = ".".join(_scope_stack())
+    payload = _payload_bytes(tree)
+    with _LOCK:
+        for dtype_name, nbytes in payload.items():
+            key = (kind, str(axis_name), dtype_name, site, scope)
+            row = _RECORDS.setdefault(key, {"calls": 0, "bytes": 0})
+            row["calls"] += 1
+            row["bytes"] += nbytes
+    # mirror into the active timeline (if one is recording) as an instant
+    # marker, so the Perfetto view shows WHICH collectives a traced region
+    # issued; deferred full-dotted-path import — the package attribute
+    # ``trace`` is the spans profiler function, not the submodule
+    from beforeholiday_tpu.monitor.trace import active_recorder
+
+    rec = active_recorder()
+    if rec is not None:
+        rec.instant(
+            f"{kind}:{site}",
+            args={"axis": str(axis_name), "scope": scope, **payload},
+        )
+
+
+# ------------------------------------------------------------------ wrappers
+# Each is signature-compatible with its jax.lax namesake plus a required
+# keyword ``site`` tag; the ledger sees the LOCAL input operand.
+
+
+def psum(x, axis_name, *, site: str, axis_index_groups=None):
+    record("psum", axis_name, x, site=site)
+    return jax.lax.psum(x, axis_name, axis_index_groups=axis_index_groups)
+
+
+def pmax(x, axis_name, *, site: str, axis_index_groups=None):
+    record("pmax", axis_name, x, site=site)
+    return jax.lax.pmax(x, axis_name, axis_index_groups=axis_index_groups)
+
+
+def pmin(x, axis_name, *, site: str, axis_index_groups=None):
+    record("pmin", axis_name, x, site=site)
+    return jax.lax.pmin(x, axis_name, axis_index_groups=axis_index_groups)
+
+
+def all_gather(x, axis_name, *, site: str, axis: int = 0, tiled: bool = False):
+    record("all_gather", axis_name, x, site=site)
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def psum_scatter(
+    x, axis_name, *, site: str, scatter_dimension: int = 0, tiled: bool = False
+):
+    record("psum_scatter", axis_name, x, site=site)
+    return jax.lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+    )
+
+
+def ppermute(x, axis_name, perm, *, site: str):
+    record("ppermute", axis_name, x, site=site)
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(
+    x, axis_name, split_axis, concat_axis, *, site: str, tiled: bool = False
+):
+    record("all_to_all", axis_name, x, site=site)
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis, concat_axis, tiled=tiled
+    )
+
+
+# ------------------------------------------------------------------- queries
+
+
+def comms_records() -> List[Dict[str, object]]:
+    """Per-key snapshot, one JSON-ready row per distinct
+    (kind, axis, dtype, site, scope): ``{"kind", "axis", "dtype", "site",
+    "scope", "calls", "bytes"}``. ``calls``/``bytes`` count trace-time
+    issues (see the module contract for the scan-body multiplier caveat)."""
+    with _LOCK:
+        items = [(k, dict(v)) for k, v in _RECORDS.items()]
+    return sorted(
+        (
+            {
+                "kind": kind,
+                "axis": axis,
+                "dtype": dtype,
+                "site": site,
+                "scope": scope,
+                "calls": c["calls"],
+                "bytes": c["bytes"],
+            }
+            for (kind, axis, dtype, site, scope), c in items
+        ),
+        key=lambda r: (r["site"], r["kind"], r["dtype"], r["scope"]),
+    )
+
+
+def comms_summary() -> List[Dict[str, object]]:
+    """Subsystem rollup, one row per site-tag prefix (the segment before the
+    first ``.``): ``{"subsystem", "sites", "calls", "bytes", "by_kind"}`` —
+    the shape ``bench.py``/MULTICHIP embed, mirroring ``dispatch_summary``."""
+    rows = comms_records()
+    by_sub: Dict[str, Dict[str, object]] = {}
+    sites_seen: Dict[str, set] = {}
+    for r in rows:
+        sub = str(r["site"]).split(".", 1)[0]
+        row = by_sub.setdefault(
+            sub, {"subsystem": sub, "sites": 0, "calls": 0, "bytes": 0,
+                  "by_kind": {}}
+        )
+        sites_seen.setdefault(sub, set()).add(r["site"])
+        row["calls"] += r["calls"]
+        row["bytes"] += r["bytes"]
+        kind_row = row["by_kind"].setdefault(
+            r["kind"], {"calls": 0, "bytes": 0}
+        )
+        kind_row["calls"] += r["calls"]
+        kind_row["bytes"] += r["bytes"]
+    for sub, row in by_sub.items():
+        row["sites"] = len(sites_seen[sub])
+    return sorted(by_sub.values(), key=lambda r: r["subsystem"])
+
+
+def reset_comms_ledger() -> None:
+    """Clear the ledger (call between entry points to scope a query; jit
+    caching means an already-compiled step will NOT re-record on re-run)."""
+    with _LOCK:
+        _RECORDS.clear()

@@ -1,0 +1,158 @@
+"""Recompile sentinel — count compilations per jitted entry point and warn on
+the silent TPU performance killer: the recompilation storm.
+
+jax caches compiled executables by ABSTRACT signature (pytree structure +
+leaf shapes/dtypes + static argument values), so a jitted entry point
+recompiles exactly when it is called with a signature it has not seen.
+``track_compiles`` exploits that: it computes the same signature key on the
+HOST at every call (cheap — shapes and treedefs only, no device work) and
+counts distinct keys per entry point. distinct-signatures == compilations,
+with no dependence on jax internals.
+
+A fluctuating-shape data pipeline or a Python scalar smuggled into a traced
+argument shows up here as an entry with ``signatures > 1`` — and a single
+``warn_once`` per entry names the entry and both signatures the moment the
+SECOND one appears, when the cause is still on screen.
+
+Usage::
+
+    @monitor.track_compiles("train_step")
+    @jax.jit
+    def train_step(params, batch): ...
+
+    monitor.compile_summary()   # [{"entry": "train_step", "signatures": 1,
+                                #   "calls": 400}]
+
+Wrap ABOVE ``jax.jit`` (the sentinel must see the concrete arguments, not
+tracers). Like the comms ledger, state is process-global and host-only;
+``reset_compile_counts`` clears it (and re-arms the warning) between
+benchmark configurations.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from beforeholiday_tpu.utils.logging import reset_warn_once, warn_once
+
+__all__ = [
+    "compile_counts",
+    "compile_summary",
+    "reset_compile_counts",
+    "track_compiles",
+]
+
+_LOCK = threading.Lock()
+# entry name -> {"signatures": {sig: first-call index}, "calls": n}
+_ENTRIES: Dict[str, Dict[str, Any]] = {}
+
+_WARN_PREFIX = "monitor.compile"
+
+
+def _leaf_sig(leaf: Any):
+    """Hashable abstract signature of one argument leaf: (shape, dtype) for
+    anything array-like, the VALUE for hashable Python statics (a changed
+    static is a recompile too), else the type name."""
+    if isinstance(leaf, (jax.Array, np.ndarray)) or hasattr(leaf, "shape"):
+        return ("array", jnp.shape(leaf), np.dtype(jnp.result_type(leaf)).name)
+    try:
+        hash(leaf)
+    except TypeError:
+        return ("unhashable", type(leaf).__name__)
+    return ("static", leaf)
+
+
+def _sig_of(args: Tuple, kwargs: Dict[str, Any]):
+    treedef = jax.tree_util.tree_structure((args, kwargs))
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    return (str(treedef), tuple(_leaf_sig(x) for x in leaves))
+
+
+def _describe(sig) -> str:
+    """Short human rendering of a signature for the warning message."""
+    return ", ".join(
+        f"{s[1]}{{{s[2]}}}" if s[0] == "array" else repr(s[1]) for s in sig[1]
+    )
+
+
+def track_compiles(entry: str):
+    """Decorator: count abstract-signature changes of a jitted entry point.
+
+    Apply OUTSIDE ``jax.jit`` so the wrapper sees concrete arguments. The
+    first signature is the expected compile; each NEW signature thereafter
+    increments the entry's compile count and (once per entry, via
+    ``warn_once``) logs a recompile warning naming the old and new shapes."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            sig = _sig_of(args, kwargs)
+            with _LOCK:
+                row = _ENTRIES.setdefault(
+                    entry, {"signatures": {}, "calls": 0}
+                )
+                row["calls"] += 1
+                known = row["signatures"]
+                is_new = sig not in known
+                if is_new:
+                    known[sig] = row["calls"]
+                n_sigs = len(known)
+            if is_new and n_sigs > 1:
+                warn_once(
+                    (_WARN_PREFIX, entry),
+                    "recompile sentinel: entry %r compiled %d distinct "
+                    "signatures (latest: %s) — fluctuating input shapes or "
+                    "statics defeat the jit cache; pad batches or hoist the "
+                    "changing value out of the traced arguments",
+                    entry,
+                    n_sigs,
+                    _describe(sig),
+                )
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def compile_counts() -> Dict[str, Dict[str, int]]:
+    """Raw per-entry counters: ``{entry: {"signatures": n, "calls": m}}``.
+    ``signatures`` is the compile count (distinct abstract signatures)."""
+    with _LOCK:
+        return {
+            name: {"signatures": len(row["signatures"]),
+                   "calls": row["calls"]}
+            for name, row in _ENTRIES.items()
+        }
+
+
+def compile_summary() -> List[Dict[str, object]]:
+    """`dispatch_summary`-style rollup: one sorted row per tracked entry,
+    ``{"entry", "signatures", "calls", "recompiled"}``."""
+    counts = compile_counts()
+    return [
+        {
+            "entry": name,
+            "signatures": c["signatures"],
+            "calls": c["calls"],
+            "recompiled": c["signatures"] > 1,
+        }
+        for name, c in sorted(counts.items())
+    ]
+
+
+def reset_compile_counts() -> None:
+    """Forget all entries and re-arm their recompile warnings. Counting
+    restarts at the next call — an already-cached executable re-counts as
+    one signature but does NOT recompile on the device."""
+    with _LOCK:
+        entries = list(_ENTRIES)
+        _ENTRIES.clear()
+    for name in entries:
+        reset_warn_once((_WARN_PREFIX, name))

@@ -81,6 +81,7 @@ class TrainMonitor:
         ("consecutive_overflows", _I32, "max"),
         ("rollbacks_total", _I32, "max"),
         ("last_skip_reason", _I32, "max"),
+        ("bn_shift_dominated", _I32, "max"),
     )
 
     def __init__(self, *, ema_decay: float = 0.99):
@@ -157,6 +158,7 @@ class TrainMonitor:
                 "consecutive_overflows",
                 "rollbacks_total",
                 "last_skip_reason",
+                "bn_shift_dominated",
             ):
                 if k in health:
                     m[k] = jnp.asarray(health[k], _I32)

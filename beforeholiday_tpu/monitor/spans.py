@@ -41,11 +41,23 @@ __all__ = [
 @contextlib.contextmanager
 def span(name: str, enabled: bool = True):
     """Named trace span (the NVTX-range idiom, gated like the reference's
-    ``prof`` flag). Zero-cost: only labels the traced HLO."""
-    if enabled:
-        with jax.named_scope(name):
-            yield
-    else:
+    ``prof`` flag). Zero-cost: only labels the traced HLO. When a
+    ``monitor.timeline`` recorder is active the span ALSO lands on the host
+    timeline (a ``B``/``E`` pair in the exported ``trace.json``) — same
+    label, both views."""
+    if not enabled:
+        yield
+        return
+    # deferred, full-dotted-path import: the package attribute ``trace`` is
+    # rebound to THIS module's profiler function, so only the dotted form
+    # reliably reaches the submodule
+    from beforeholiday_tpu.monitor.trace import active_recorder
+
+    rec = active_recorder()
+    with contextlib.ExitStack() as stack:
+        if rec is not None:
+            stack.enter_context(rec.span(name))
+        stack.enter_context(jax.named_scope(name))
         yield
 
 
@@ -60,7 +72,7 @@ def annotate(name: str):
     def deco(fn):
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
-            with jax.named_scope(name):
+            with span(name):
                 return fn(*args, **kwargs)
 
         return wrapped

@@ -1,0 +1,174 @@
+"""Host-side timeline recorder + Chrome-trace/Perfetto ``trace.json`` export.
+
+``monitor/spans.py`` labels the HLO (``jax.named_scope``) so device activity
+shows up in XProf; this module is the HOST half — a wall-clock event recorder
+whose output loads directly in Perfetto / ``chrome://tracing`` (the JSON
+Trace Event Format: https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU).
+
+What the timeline shows: host-side activity — tracing/compilation of jitted
+entry points, dispatch, and between-step host work. Spans opened inside a
+jitted function measure TRACE time (the function body runs once, when XLA
+builds the program), not device execution; device-side timelines remain
+XProf's job (``monitor.spans.trace``). The two views compose: the recorder
+timestamps where the HOST went, the comms ledger instants mark which
+collectives each traced region issued.
+
+Layout: one Chrome-trace *process* row per rank (``pid`` = rank; process
+metadata names the row), one *thread* row per recording host thread. Spans
+are ``B``/``E`` begin/end pairs (they nest per pid/tid), instants are ``i``
+events.
+
+Usage::
+
+    with monitor.timeline("trace.json") as rec:
+        step(params, batch)          # spans/comms instants land in rec
+        rec.instant("ckpt_saved")
+    # exported on exit; open trace.json in Perfetto
+
+``export`` is the module's ONE file-write path and is the only function the
+no-host-sync AST scan sanctions for this file (it writes host dicts — it
+still never reads a device value).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "TraceRecorder",
+    "active_recorder",
+    "timeline",
+]
+
+
+class TraceRecorder:
+    """Append-only host event recorder in Chrome trace-event form.
+
+    Thread-safe; timestamps are ``time.perf_counter_ns`` microseconds
+    relative to construction (Chrome traces want microseconds)."""
+
+    def __init__(self, *, process_name: str = "beforeholiday_tpu"):
+        self._t0 = time.perf_counter_ns()
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._pids: Dict[int, int] = {}  # rank -> pid (identity; dedup only)
+        self._tids: Dict[int, int] = {}  # thread ident -> small tid
+        self._process_name = process_name
+
+    # ------------------------------------------------------------- internals
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def _pid_tid(self, rank: int):
+        """Register (and name) the rank's process row and this thread's
+        thread row on first use. Caller holds no lock."""
+        ident = threading.get_ident()
+        with self._lock:
+            if rank not in self._pids:
+                self._pids[rank] = rank
+                self._events.append({
+                    "ph": "M", "name": "process_name", "pid": rank, "tid": 0,
+                    "args": {"name": f"{self._process_name} rank {rank}"},
+                })
+                self._events.append({
+                    "ph": "M", "name": "process_sort_index", "pid": rank,
+                    "tid": 0, "args": {"sort_index": rank},
+                })
+            if ident not in self._tids:
+                self._tids[ident] = len(self._tids)
+        return rank, self._tids[ident]
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            self._events.append(ev)
+
+    # ------------------------------------------------------------ recording
+    def begin(self, name: str, *, rank: int = 0,
+              args: Optional[Dict[str, Any]] = None) -> None:
+        pid, tid = self._pid_tid(rank)
+        ev = {"ph": "B", "name": name, "pid": pid, "tid": tid,
+              "ts": self._now_us()}
+        if args:
+            ev["args"] = dict(args)
+        self._append(ev)
+
+    def end(self, *, rank: int = 0) -> None:
+        pid, tid = self._pid_tid(rank)
+        self._append({"ph": "E", "pid": pid, "tid": tid, "ts": self._now_us()})
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, rank: int = 0,
+             args: Optional[Dict[str, Any]] = None):
+        """Nested host span (``B``/``E`` pair). ``monitor.spans.span`` routes
+        here automatically while a recorder is active."""
+        self.begin(name, rank=rank, args=args)
+        try:
+            yield
+        finally:
+            self.end(rank=rank)
+
+    def instant(self, name: str, *, rank: int = 0,
+                args: Optional[Dict[str, Any]] = None) -> None:
+        """Zero-duration marker (the comms ledger mirrors collective records
+        here as ``kind:site`` instants)."""
+        pid, tid = self._pid_tid(rank)
+        ev = {"ph": "i", "name": name, "pid": pid, "tid": tid,
+              "ts": self._now_us(), "s": "t"}
+        if args:
+            ev["args"] = dict(args)
+        self._append(ev)
+
+    # -------------------------------------------------------------- queries
+    def events(self) -> List[Dict[str, Any]]:
+        """Snapshot of the raw event list (host dicts; no device values)."""
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    # --------------------------------------------------------------- export
+    def export(self, path: str) -> None:
+        """Write ``{"traceEvents": [...]}`` — loads in Perfetto /
+        ``chrome://tracing`` as-is. The module's one sanctioned write path
+        (host-side data only; there is nothing to read back)."""
+        payload = {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f)
+
+
+# ------------------------------------------------------- active recorder
+# Process-global by design, like warn_once: spans and the comms ledger fire
+# from deep inside library code that cannot thread a recorder handle.
+_ACTIVE: Optional[TraceRecorder] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_recorder() -> Optional[TraceRecorder]:
+    """The recorder installed by ``timeline`` (None when not recording) —
+    the hook ``spans.span`` and ``comms.record`` consult."""
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def timeline(path: Optional[str] = None, *,
+             recorder: Optional[TraceRecorder] = None):
+    """Install a recorder as process-active for the block; export to ``path``
+    on exit when given. Yields the recorder. Re-entrant (the previous
+    recorder is restored), though nested timelines record independently."""
+    global _ACTIVE
+    rec = recorder if recorder is not None else TraceRecorder()
+    with _ACTIVE_LOCK:
+        prev = _ACTIVE
+        _ACTIVE = rec
+    try:
+        yield rec
+    finally:
+        with _ACTIVE_LOCK:
+            _ACTIVE = prev
+        if path is not None:
+            rec.export(path)

@@ -84,9 +84,20 @@ def ew_call(
     state overwrites the old state's buffer, the reference kernels' native
     mode — they mutate the tensor lists). Measured r5: the aliased Adam
     kernel streams ~1.8x faster than fresh-output buffers (4.2 -> 2.3 ms
-    incl. grad refresh at 46M fp32). XLA inserts a copy automatically if the
-    caller still holds the input live, so this is always safe. Applied only
-    when dtypes match.
+    incl. grad refresh at 46M fp32).
+
+    Aliasing safety is OBSERVED XLA:TPU behavior, not a Pallas API contract:
+    current XLA inserts a defensive copy when the caller still holds the
+    aliased input live, so donation has not been seen to corrupt a live
+    value — but ``input_output_aliases`` is documented as a donation hint,
+    and a backend/version that honors it more aggressively would make
+    aliasing-with-live-input undefined. Callers should treat the input as
+    CONSUMED. Note also the silent degrade below: a dtype-mismatched pair is
+    dropped from the alias map without warning (the kernel still runs, just
+    without in-place reuse), so a wrong-dtype state buffer quietly loses the
+    1.8x. ``testing/tpu_checks.py`` is the enforcement point — its
+    optimizer parity checks compare aliased against fresh-buffer results on
+    real hardware and would surface either failure mode.
     """
     if interpret is None:
         interpret = _interpret_default()

@@ -28,6 +28,7 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.ops import multi_tensor as mt
 from beforeholiday_tpu.ops.arena import TILE, flatten, make_spec, unflatten
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
@@ -73,7 +74,8 @@ class _DistributedFused:
     def _gather_full(self, shard_arr, spec):
         """all_gather a state shard back into full per-tensor pieces — the one
         inverse used by _gather_params/state_dict."""
-        full = jax.lax.all_gather(shard_arr, self.axis_name, axis=0, tiled=True)
+        full = comms.all_gather(shard_arr, self.axis_name,
+                                site="zero2.gather_state", axis=0, tiled=True)
         return unflatten(full[: spec.padded_total], spec)
 
     def init(self, params):
@@ -91,8 +93,9 @@ class _DistributedFused:
         gleaves = jax.tree_util.tree_leaves(grads)
         gflat, _ = flatten(gleaves, dtype=jnp.float32)
         gflat = _pad_to(gflat, shard * self._world())
-        g_shard = jax.lax.psum_scatter(
-            gflat, self.axis_name, scatter_dimension=0, tiled=True
+        g_shard = comms.psum_scatter(
+            gflat, self.axis_name, site="zero2.reduce_scatter_grads",
+            scatter_dimension=0, tiled=True
         )
         if self.grad_average:
             g_shard = g_shard / self._world()
@@ -113,7 +116,8 @@ class _DistributedFused:
         flag = local_bad if found_inf is None else (
             local_bad | (jnp.asarray(found_inf) != 0)
         )
-        return jax.lax.pmax(flag.astype(jnp.float32), self.axis_name) != 0
+        return comms.pmax(flag.astype(jnp.float32), self.axis_name,
+                          site="zero2.found_inf") != 0
 
     # -- checkpointing (ref: distributed_fused_adam.py:1123-1150
     # ``state_dict(gather_on_root=True)`` + ``load_state_dict``) --------------
@@ -254,7 +258,8 @@ class DistributedFusedLAMB(_DistributedFused):
 
         # global grad norm for clipping (ref: fused_lamb step's l2norm)
         gnorm = jnp.sqrt(
-            jax.lax.psum(jnp.sum(g_shard.astype(jnp.float32) ** 2), self.axis_name)
+            comms.psum(jnp.sum(g_shard.astype(jnp.float32) ** 2),
+                       self.axis_name, site="zero2.lamb_gnorm")
         )
         [p2], [m2], [v2] = mt.multi_tensor_lamb(
             [g_shard], [state["master"]], [state["exp_avg"]], [state["exp_avg_sq"]],

@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.monitor.spans import span
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
 
@@ -87,13 +88,20 @@ def reduce_gradients(
         mismatch = None
         if check_consistency:
             fp = _grad_fingerprint(grads)
-            hi = jax.lax.pmax(fp, axis_name)
-            lo = jax.lax.pmin(fp, axis_name)
+            hi = comms.pmax(fp, axis_name, site="ddp.grad_fingerprint")
+            lo = comms.pmin(fp, axis_name, site="ddp.grad_fingerprint")
             # the non-finite test is rank-LOCAL (pmax may drop a lone NaN under
             # maxNum semantics), so the combined flag gets its own reduction —
             # every rank must return the same verdict
             local_bad = jnp.any(hi != lo) | jnp.any(~jnp.isfinite(fp))
-            mismatch = jax.lax.pmax(local_bad.astype(jnp.int32), axis_name) > 0
+            mismatch = (
+                comms.pmax(
+                    local_bad.astype(jnp.int32),
+                    axis_name,
+                    site="ddp.grad_fingerprint",
+                )
+                > 0
+            )
 
         def _reduce(g):
             orig_dtype = g.dtype
@@ -101,7 +109,7 @@ def reduce_gradients(
                 g = g.astype(jnp.float32)
             if gradient_predivide_factor is not None:
                 g = g / gradient_predivide_factor
-            g = jax.lax.psum(g, axis_name)
+            g = comms.psum(g, axis_name, site="ddp.reduce_gradients")
             if gradient_average:
                 if gradient_predivide_factor is not None:
                     g = g / (world / gradient_predivide_factor)
@@ -137,8 +145,10 @@ class Reducer:
         with span("ddp_broadcast_params"):
             is_src = jax.lax.axis_index(self.axis_name) == 0
             return jax.tree.map(
-                lambda p: jax.lax.psum(
-                    jnp.where(is_src, p, jnp.zeros((), p.dtype)), self.axis_name
+                lambda p: comms.psum(
+                    jnp.where(is_src, p, jnp.zeros((), p.dtype)),
+                    self.axis_name,
+                    site="ddp.broadcast_params",
                 ),
                 params,
             )

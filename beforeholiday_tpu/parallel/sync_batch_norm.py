@@ -22,6 +22,8 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor import comms
+
 
 class BatchNormParams(NamedTuple):
     scale: jax.Array  # (C,)
@@ -55,8 +57,10 @@ def sync_batch_norm(
     fuse_relu: bool = False,
     residual: Optional[jax.Array] = None,
     stats: str = "auto",
+    return_diagnostics: bool = False,
 ) -> Tuple[jax.Array, BatchNormState]:
-    """Apply (Sync)BatchNorm. Returns (y, new_state).
+    """Apply (Sync)BatchNorm. Returns (y, new_state), or
+    (y, new_state, diagnostics) with ``return_diagnostics=True``.
 
     x: (N, C, *spatial) or (N, *spatial, C) when ``channel_last`` (the
     reference's NHWC path). With ``axis_name`` set (inside shard_map), batch
@@ -85,6 +89,15 @@ def sync_batch_norm(
       SyncBN): global mean first, then the centered second moment — the
       reference's Welford-merge stability (welford.cu) with no conditioning
       contract at the cost of a second activation read.
+
+    ``return_diagnostics``: also return a dict of cheap on-device i32 flags.
+    ``bn_shift_dominated`` is 1 when any channel left the one_pass_shifted
+    accuracy envelope — ``dmean^2 > 30^2 * (var + eps)``, i.e. the batch
+    mean drifted ~30 sigma from the running-mean shift and the
+    E[d^2] - E[d]^2 combine is at risk of catastrophic cancellation (the
+    cue to pass ``stats="two_pass"``). Costs two per-channel compares on
+    values already computed; always 0 for two_pass/eval. Fold it into
+    ``TrainMonitor`` via the ``bn_shift_dominated`` health key.
     """
     if stats == "auto":
         stats = "two_pass" if axis_name is not None else "one_pass_shifted"
@@ -102,6 +115,7 @@ def sync_batch_norm(
 
     xf = x.astype(jnp.float32)
 
+    shift_dominated = jnp.int32(0)
     if training:
         count = jnp.float32(math.prod(x.shape[i] for i in reduce_axes))
         if stats == "two_pass":
@@ -111,16 +125,19 @@ def sync_batch_norm(
             groups = axis_index_groups
             local_sum = jnp.sum(xf, axis=reduce_axes)
             if axis_name is not None:
-                count = jax.lax.psum(count, axis_name, axis_index_groups=groups)
-                local_sum = jax.lax.psum(local_sum, axis_name,
-                                         axis_index_groups=groups)
+                count = comms.psum(count, axis_name, site="sync_bn.stats",
+                                   axis_index_groups=groups)
+                local_sum = comms.psum(local_sum, axis_name,
+                                       site="sync_bn.stats",
+                                       axis_index_groups=groups)
             mean = local_sum / count
             centered_sq = jnp.sum(
                 jnp.square(xf - mean.reshape(shape_bc)), axis=reduce_axes
             )
             if axis_name is not None:
-                centered_sq = jax.lax.psum(centered_sq, axis_name,
-                                           axis_index_groups=groups)
+                centered_sq = comms.psum(centered_sq, axis_name,
+                                         site="sync_bn.stats",
+                                         axis_index_groups=groups)
             var = centered_sq / count
         else:
             # one read of the activations: moments accumulate around the
@@ -136,6 +153,12 @@ def sync_batch_norm(
             dmean = s1 / count
             mean = shift + dmean
             var = jnp.maximum(s2 / count - dmean * dmean, 0.0)
+            # envelope tripwire (see docstring): per-channel, did the shift
+            # correction dominate the retained variance? Two compares on
+            # already-computed vectors — jit-safe, no readback.
+            shift_dominated = jnp.any(
+                dmean * dmean > (30.0**2) * (var + eps)
+            ).astype(jnp.int32)
         # running stats use unbiased variance (torch semantics)
         unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
         new_state = BatchNormState(
@@ -155,4 +178,8 @@ def sync_batch_norm(
         y = y + residual.astype(jnp.float32)
     if fuse_relu:
         y = jax.nn.relu(y)
+    if return_diagnostics:
+        return y.astype(x.dtype), new_state, {
+            "bn_shift_dominated": shift_dominated
+        }
     return y.astype(x.dtype), new_state

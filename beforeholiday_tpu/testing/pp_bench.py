@@ -119,11 +119,19 @@ def main():
 
     t_seq = _time(seq, (stacked, inputs, targets))
     t_pp = _time(pipe_step, (stacked, inputs, targets))
+
+    # the schedule recorded its report at trace time; fall back to the
+    # closed form if the engine traced before this module imported
+    report = pp.last_schedule_report() or pp.schedule_report(M, S)
     print(json.dumps({
         "pp_1f1b_ms": round(t_pp * 1e3, 2),
         "sequential_ms": round(t_seq * 1e3, 2),
         "pp_overhead_vs_sequential": round(t_pp / t_seq, 3),
         "loss_abs_err": float(err),
+        "bubble_fraction": round(report["analytic_bubble_fraction"], 4),
+        "engine_bubble_fraction": round(report["engine_bubble_fraction"], 4),
+        "total_ticks": report["total_ticks"],
+        "phase_counts": report["per_rank"],
         "config": f"S={S} M={M} hidden={HIDDEN} micro={MICRO}",
     }))
 

@@ -29,6 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.ops._pallas_util import resolve_impl as _resolve_impl
 from beforeholiday_tpu.parallel.parallel_state import CONTEXT_AXIS
 
@@ -108,9 +109,13 @@ def ring_attention(
     def body(carry, t):
         k_cur, v_cur, m, l, acc = carry
         # rotate first: compute on the received chunk overlaps the next
-        # step's transfer under XLA's latency-hiding scheduler
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        # step's transfer under XLA's latency-hiding scheduler. Ledger note:
+        # inside the scan body these record ONCE per trace but execute cp-1
+        # times per call (the comms.py scan-body caveat).
+        k_cur = comms.ppermute(k_cur, axis_name, perm,
+                               site="cp.ring_attention.kv")
+        v_cur = comms.ppermute(v_cur, axis_name, perm,
+                               site="cp.ring_attention.kv")
         src = (rank - t) % cp
         m, l, acc = accum(k_cur, v_cur, src, m, l, acc)
         return (k_cur, v_cur, m, l, acc), None
@@ -167,8 +172,11 @@ def _ring_attention_flash(q, k, v, *, causal, scale, axis_name, cp, rank, perm):
 
     def body(carry, t):
         k_cur, v_cur, o_acc, lse_acc = carry
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        # scan-body ledger caveat as in the jnp path: one record, cp-1 hops
+        k_cur = comms.ppermute(k_cur, axis_name, perm,
+                               site="cp.ring_attention.kv")
+        v_cur = comms.ppermute(v_cur, axis_name, perm,
+                               site="cp.ring_attention.kv")
         src = (rank - t) % cp
         o_t, lse_t = hop(k_cur, v_cur, src, False)
         o_acc, lse_acc = _merge_by_lse(o_acc, lse_acc, o_t, lse_t)

@@ -9,10 +9,14 @@ from beforeholiday_tpu.transformer.pipeline_parallel import p2p_communication  #
 from beforeholiday_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     PipelineGrads,
     activation_ring_depth,
+    analytic_bubble_fraction,
     EncDecPipelineGrads,
     forward_backward_no_pipelining,
     forward_backward_pipelining_encoder_decoder,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
+    last_schedule_report,
+    phase_counts,
+    schedule_report,
 )

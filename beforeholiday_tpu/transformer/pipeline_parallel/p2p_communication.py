@@ -17,6 +17,7 @@ from typing import Optional
 
 import jax
 
+from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.parallel.parallel_state import PIPE_AXIS
 
 
@@ -29,12 +30,14 @@ def send_forward_recv_forward(x, *, axis_name: str = PIPE_AXIS):
     """Every stage sends its activation to the next stage and receives the
     previous stage's (ref: send_forward + recv_forward fused, :048-110). The
     first stage receives stage N-1's value — callers mask it."""
-    return jax.lax.ppermute(x, axis_name, _ring(axis_name, +1))
+    return comms.ppermute(x, axis_name, _ring(axis_name, +1),
+                          site="pp.fwd_ring")
 
 
 def send_backward_recv_backward(dy, *, axis_name: str = PIPE_AXIS):
     """Gradient ring in the reverse direction (ref: send_backward_recv_backward)."""
-    return jax.lax.ppermute(dy, axis_name, _ring(axis_name, -1))
+    return comms.ppermute(dy, axis_name, _ring(axis_name, -1),
+                          site="pp.bwd_ring")
 
 
 # aliases matching the reference's public names; under a collective ring the
@@ -49,8 +52,9 @@ def send_forward_recv_backward(y, dy, *, axis_name: str = PIPE_AXIS):
     """Steady-state 1F1B pair (ref: :send_forward_recv_backward): activation
     ring forward, gradient ring backward, one tick."""
     return (
-        jax.lax.ppermute(y, axis_name, _ring(axis_name, +1)),
-        jax.lax.ppermute(dy, axis_name, _ring(axis_name, -1)),
+        comms.ppermute(y, axis_name, _ring(axis_name, +1), site="pp.fwd_ring"),
+        comms.ppermute(dy, axis_name, _ring(axis_name, -1),
+                       site="pp.bwd_ring"),
     )
 
 

@@ -44,11 +44,13 @@ accumulate to the mean-loss gradient.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+import threading
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.monitor.spans import span
 from beforeholiday_tpu.parallel.parallel_state import PIPE_AXIS
 from beforeholiday_tpu.transformer.pipeline_parallel import p2p_communication
@@ -100,6 +102,112 @@ def activation_ring_depth(V: int, S: int) -> int:
     return 2 * V * S
 
 
+# --- bubble accounting -----------------------------------------------------
+#
+# All host-side integer arithmetic over STATIC schedule parameters (M, S, V
+# are Python ints at trace time — axis_size is static inside shard_map), so
+# the engines record a report once per compilation at zero device cost, the
+# same contract as the comms ledger.
+
+
+def analytic_bubble_fraction(
+    num_microbatches: int, pipeline_size: int, virtual_size: int = 1
+) -> float:
+    """Closed-form pipeline-bubble fraction of the (interleaved) 1F1B
+    schedule: ``((p-1)/v) / (m + (p-1)/v)`` — Megatron-LM's Section 2.2
+    formula; at v=1 the familiar ``(p-1)/(m+p-1)``. The idle fraction of an
+    IDEAL async 1F1B diamond, the target the tick-loop engine approximates
+    (its own tick utilization is ``engine_bubble_fraction`` in
+    ``schedule_report``)."""
+    m, p, v = num_microbatches, pipeline_size, virtual_size
+    if p <= 1:
+        return 0.0
+    penalty = (p - 1) / v
+    return penalty / (m + penalty)
+
+
+def phase_counts(
+    num_microbatches: int,
+    pipeline_size: int,
+    rank: int,
+    virtual_size: int = 1,
+) -> Dict[str, int]:
+    """Per-rank 1F1B phase decomposition: forwards run before the first
+    backward (``warmup``), interleaved F/B pairs (``steady``), and trailing
+    backwards (``cooldown``) — the reference's num_warmup_microbatches
+    arithmetic (fwd_bwd_pipelining_without_interleaving.py:323, and the
+    interleaved variant's ``(p - r - 1)*2 + (v-1)*p``). Counts are in
+    microbatch-slots (m*v total per rank)."""
+    m, p, r, v = num_microbatches, pipeline_size, rank, virtual_size
+    total = m * v
+    if v > 1:
+        warmup = min((p - r - 1) * 2 + (v - 1) * p, total)
+    else:
+        warmup = min(p - r - 1, total)
+    return {
+        "rank": r,
+        "warmup": warmup,
+        "steady": total - warmup,
+        "cooldown": warmup,
+    }
+
+
+_REPORT_LOCK = threading.Lock()
+_LAST_REPORT: Optional[Dict[str, Any]] = None
+
+
+def schedule_report(
+    num_microbatches: int,
+    pipeline_size: int,
+    *,
+    virtual_size: int = 1,
+    schedule: str = "1f1b",
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """JSON-ready description of one pipelined run's schedule: config,
+    ``total_ticks`` of the collective tick loop, its tick-level idle fraction
+    (``engine_bubble_fraction`` — each rank fills M*V of the loop's F and B
+    slots), the ideal-schedule ``analytic_bubble_fraction``, and the
+    ``phase_counts`` row per rank. The engines record this at trace time;
+    read it back via ``last_schedule_report`` or the active timeline."""
+    m, p, v = num_microbatches, pipeline_size, virtual_size
+    total_ticks = m * v + v * p + p - 1
+    report: Dict[str, Any] = {
+        "schedule": schedule,
+        "num_microbatches": m,
+        "pipeline_size": p,
+        "virtual_size": v,
+        "total_ticks": total_ticks,
+        "engine_bubble_fraction": (total_ticks - m * v) / total_ticks,
+        "analytic_bubble_fraction": analytic_bubble_fraction(m, p, v),
+        "per_rank": [phase_counts(m, p, r, v) for r in range(p)],
+    }
+    if extra:
+        report.update(extra)
+    return report
+
+
+def _record_schedule(report: Dict[str, Any]) -> None:
+    """Stash the report host-side and mirror it onto the active timeline (an
+    instant marker at the moment the schedule traced)."""
+    global _LAST_REPORT
+    with _REPORT_LOCK:
+        _LAST_REPORT = report
+    from beforeholiday_tpu.monitor.trace import active_recorder
+
+    rec = active_recorder()
+    if rec is not None:
+        rec.instant(f"pp.schedule:{report['schedule']}", args=dict(report))
+
+
+def last_schedule_report() -> Optional[Dict[str, Any]]:
+    """The most recent pipelined schedule's report (None before any trace).
+    Trace-time semantics: re-running an already-compiled schedule does not
+    re-record, exactly like the comms ledger."""
+    with _REPORT_LOCK:
+        return None if _LAST_REPORT is None else dict(_LAST_REPORT)
+
+
 class PipelineGrads(NamedTuple):
     """Gradients from a pipelined run with embed/head stages."""
 
@@ -145,6 +253,10 @@ def _pipelined_fwd_bwd(
         )
     total_ticks = M * V + V * S + S - 1  # at V=1: the familiar M + 2S - 1
     ring_depth = activation_ring_depth(V, S)
+    _record_schedule(schedule_report(
+        M, S, virtual_size=V,
+        schedule="interleaved_1f1b" if V > 1 else "1f1b",
+    ))
 
     is_first_dev = rank == 0
     is_last_dev = rank == S - 1
@@ -340,11 +452,19 @@ def _pipelined_fwd_bwd(
     # every stage reports the mean loss (ref: losses_reduced broadcast); embed/
     # head grads live on their stage only and are zero elsewhere, so the same
     # psum makes them whole everywhere
-    loss = jax.lax.psum(loss, axis_name)
+    loss = comms.psum(loss, axis_name, site="pp.loss_allreduce")
     if embed_fn is not None:
-        g_embed = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_embed)
+        g_embed = jax.tree.map(
+            lambda g: comms.psum(g, axis_name,
+                                 site="pp.embed_head_allreduce"),
+            g_embed,
+        )
     if head_fn is not None:
-        g_head = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_head)
+        g_head = jax.tree.map(
+            lambda g: comms.psum(g, axis_name,
+                                 site="pp.embed_head_allreduce"),
+            g_head,
+        )
     return loss, g_stage, g_embed, g_head
 
 
@@ -477,6 +597,10 @@ def forward_backward_pipelining_encoder_decoder(
         )
     total_ticks = M + 2 * S - 1
     ring_depth = 2 * S
+    _record_schedule(schedule_report(
+        M, S, schedule="1f1b_encoder_decoder",
+        extra={"split_rank": int(split_rank)},
+    ))
 
     is_first_dev = rank == 0
     is_last_dev = rank == S - 1
@@ -654,13 +778,25 @@ def forward_backward_pipelining_encoder_decoder(
         (act_store0, fwd_reg0, bwd_reg0, zeros_stage_g, zeros_ee_g, zeros_de_g,
          zeros_head_g, jnp.float32(0.0)),
     )
-    loss = jax.lax.psum(loss, axis_name)
+    loss = comms.psum(loss, axis_name, site="pp.loss_allreduce")
     if enc_embed_fn is not None:
-        g_ee = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_ee)
+        g_ee = jax.tree.map(
+            lambda g: comms.psum(g, axis_name,
+                                 site="pp.embed_head_allreduce"),
+            g_ee,
+        )
     if dec_embed_fn is not None:
-        g_de = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_de)
+        g_de = jax.tree.map(
+            lambda g: comms.psum(g, axis_name,
+                                 site="pp.embed_head_allreduce"),
+            g_de,
+        )
     if head_fn is not None:
-        g_head = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_head)
+        g_head = jax.tree.map(
+            lambda g: comms.psum(g, axis_name,
+                                 site="pp.embed_head_allreduce"),
+            g_head,
+        )
     return loss, EncDecPipelineGrads(g_stage, g_ee, g_de, g_head)
 
 

@@ -15,6 +15,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
 from beforeholiday_tpu.transformer.tensor_parallel.layers import vocab_range
 
@@ -23,18 +24,20 @@ def _fwd_math(logits, target, vocab_size, axis_name):
     """Returns (loss, (softmax_local, target_mask_local, local_idx))."""
     x = logits.astype(jnp.float32)
     # 1. global max for stability (allreduce MAX, ref :31-36)
-    xmax = jax.lax.pmax(jnp.max(x, axis=-1), axis_name)
+    xmax = comms.pmax(jnp.max(x, axis=-1), axis_name,
+                      site="tp.vocab_cross_entropy")
     x = x - xmax[..., None]
     # 2. global sum of exp (allreduce SUM, ref :56-62)
     ex = jnp.exp(x)
-    sum_ex = jax.lax.psum(jnp.sum(ex, axis=-1), axis_name)
+    sum_ex = comms.psum(jnp.sum(ex, axis=-1), axis_name,
+                        site="tp.vocab_cross_entropy")
     # 3. target logit: only the owning rank contributes (ref :38-54)
     start, local = vocab_range(vocab_size, axis_name)
     in_range = (target >= start) & (target < start + local)
     local_idx = jnp.where(in_range, target - start, 0)
     tgt = jnp.take_along_axis(x, local_idx[..., None], axis=-1)[..., 0]
     tgt = jnp.where(in_range, tgt, 0.0)
-    tgt = jax.lax.psum(tgt, axis_name)
+    tgt = comms.psum(tgt, axis_name, site="tp.vocab_cross_entropy")
     loss = jnp.log(sum_ex) - tgt
     softmax_local = ex / sum_ex[..., None]
     return loss, (softmax_local, in_range, local_idx)
@@ -58,7 +61,10 @@ def _ce_fwd(logits, target, vocab_size, label_smoothing, axis_name):
     )
     if label_smoothing > 0:
         log_probs = jnp.log(jnp.maximum(softmax_local, 1e-30))
-        mean_log = jax.lax.psum(jnp.sum(log_probs, axis=-1), axis_name) / vocab_size
+        mean_log = comms.psum(
+            jnp.sum(log_probs, axis=-1), axis_name,
+            site="tp.vocab_cross_entropy",
+        ) / vocab_size
         loss = (1.0 - label_smoothing) * loss - label_smoothing * mean_log
     # zero-size sentinel carries the primal dtype through the residuals
     return loss, (softmax_local, in_range, local_idx, jnp.zeros((0,), logits.dtype))

@@ -14,6 +14,7 @@ from typing import Dict, Sequence
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
 
 
@@ -42,6 +43,6 @@ def broadcast_data(
             raise TypeError(f"broadcast_data: {k} has dtype {v.dtype}, expected {datatype}")
         if force:
             is_src = (jax.lax.axis_index(axis_name) == 0).astype(v.dtype)
-            v = jax.lax.psum(v * is_src, axis_name)
+            v = comms.psum(v * is_src, axis_name, site="tp.broadcast_data")
         out[k] = v
     return out

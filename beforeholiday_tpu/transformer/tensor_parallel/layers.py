@@ -19,6 +19,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor.comms import ledger_scope
 from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
 from beforeholiday_tpu.transformer.tensor_parallel import mappings as mp
 
@@ -39,19 +40,20 @@ def column_parallel_linear(
     the fusion at layers.py:293-306,355-363. Otherwise x is replicated and the
     f-conjugate (id fwd / psum bwd) applies.
     """
-    if sequence_parallel:
-        x = mp.gather_from_sequence_parallel_region(
-            x, axis_name, True  # bwd reduce-scatters the dgrad
-        )
-    else:
-        x = mp.copy_to_tensor_model_parallel_region(x, axis_name)
-    y = x @ weight.astype(x.dtype)
-    if bias is not None:
-        y = y + bias.astype(y.dtype)
-    if gather_output:
-        assert not sequence_parallel, "cannot gather output in sequence-parallel mode"
-        y = mp.gather_from_tensor_model_parallel_region(y, axis_name)
-    return y
+    with ledger_scope("column_parallel_linear"):
+        if sequence_parallel:
+            x = mp.gather_from_sequence_parallel_region(
+                x, axis_name, True  # bwd reduce-scatters the dgrad
+            )
+        else:
+            x = mp.copy_to_tensor_model_parallel_region(x, axis_name)
+        y = x @ weight.astype(x.dtype)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        if gather_output:
+            assert not sequence_parallel, "cannot gather output in sequence-parallel mode"
+            y = mp.gather_from_tensor_model_parallel_region(y, axis_name)
+        return y
 
 
 def row_parallel_linear(
@@ -69,17 +71,18 @@ def row_parallel_linear(
     the sequence dim when ``sequence_parallel`` (layers.py:744-771). The bias is
     added *after* the reduction, on full values, exactly as the reference.
     """
-    if not input_is_parallel:
-        assert not sequence_parallel
-        x = mp.scatter_to_tensor_model_parallel_region(x, axis_name)
-    y_partial = x @ weight.astype(x.dtype)
-    if sequence_parallel:
-        y = mp.reduce_scatter_to_sequence_parallel_region(y_partial, axis_name)
-    else:
-        y = mp.reduce_from_tensor_model_parallel_region(y_partial, axis_name)
-    if bias is not None:
-        y = y + bias.astype(y.dtype)
-    return y
+    with ledger_scope("row_parallel_linear"):
+        if not input_is_parallel:
+            assert not sequence_parallel
+            x = mp.scatter_to_tensor_model_parallel_region(x, axis_name)
+        y_partial = x @ weight.astype(x.dtype)
+        if sequence_parallel:
+            y = mp.reduce_scatter_to_sequence_parallel_region(y_partial, axis_name)
+        else:
+            y = mp.reduce_from_tensor_model_parallel_region(y_partial, axis_name)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return y
 
 
 def vocab_range(vocab_size: int, axis_name: str = TENSOR_AXIS) -> Tuple[jax.Array, int]:
@@ -105,9 +108,10 @@ def vocab_parallel_embedding(
     scatter-add into the local shard for locally-owned tokens — falls out of
     autodiff through the mask; the psum is pinned id-bwd via the g-conjugate.
     """
-    start, local = vocab_range(vocab_size, axis_name)
-    in_range = (tokens >= start) & (tokens < start + local)
-    local_idx = jnp.where(in_range, tokens - start, 0)
-    out = weight[local_idx]
-    out = jnp.where(in_range[..., None], out, 0.0)
-    return mp.reduce_from_tensor_model_parallel_region(out, axis_name)
+    with ledger_scope("vocab_parallel_embedding"):
+        start, local = vocab_range(vocab_size, axis_name)
+        in_range = (tokens >= start) & (tokens < start + local)
+        local_idx = jnp.where(in_range, tokens - start, 0)
+        out = weight[local_idx]
+        out = jnp.where(in_range[..., None], out, 0.0)
+        return mp.reduce_from_tensor_model_parallel_region(out, axis_name)

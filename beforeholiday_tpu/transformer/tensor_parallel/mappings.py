@@ -22,6 +22,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
 
 
@@ -35,12 +36,14 @@ def _split_along(x, dim, axis_name):
     return jax.lax.dynamic_slice_in_dim(x, rank * shard, shard, axis=dim)
 
 
-def _all_gather(x, dim, axis_name):
-    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+def _all_gather(x, dim, axis_name, *, site):
+    return comms.all_gather(x, axis_name, site=site, axis=dim, tiled=True)
 
 
-def _reduce_scatter(x, dim, axis_name):
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+def _reduce_scatter(x, dim, axis_name, *, site):
+    return comms.psum_scatter(
+        x, axis_name, site=site, scatter_dimension=dim, tiled=True
+    )
 
 
 # --- f / g conjugates --------------------------------------------------------------
@@ -57,7 +60,7 @@ def _copy_fwd(x, axis_name):
 
 
 def _copy_bwd(axis_name, _, dy):
-    return (jax.lax.psum(dy, axis_name),)
+    return (comms.psum(dy, axis_name, site="tp.copy_to_region.bwd"),)
 
 
 copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
@@ -66,11 +69,11 @@ copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def reduce_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
     """Allreduce forward, identity backward (ref: mappings.py:48-68 ``_ReduceFromModelParallelRegion``)."""
-    return jax.lax.psum(x, axis_name)
+    return comms.psum(x, axis_name, site="tp.reduce_from_region")
 
 
 def _reduce_fwd(x, axis_name):
-    return jax.lax.psum(x, axis_name), None
+    return comms.psum(x, axis_name, site="tp.reduce_from_region"), None
 
 
 def _reduce_bwd(axis_name, _, dy):
@@ -94,7 +97,7 @@ def _scatter_fwd(x, axis_name):
 
 
 def _scatter_bwd(axis_name, _, dy):
-    return (_all_gather(dy, -1, axis_name),)
+    return (_all_gather(dy, -1, axis_name, site="tp.scatter_to_region.bwd"),)
 
 
 scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
@@ -103,11 +106,11 @@ scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def gather_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
     """All-gather last dim fwd, split bwd (ref: mappings.py:102-135)."""
-    return _all_gather(x, -1, axis_name)
+    return _all_gather(x, -1, axis_name, site="tp.gather_from_region")
 
 
 def _gather_fwd(x, axis_name):
-    return _all_gather(x, -1, axis_name), None
+    return _all_gather(x, -1, axis_name, site="tp.gather_from_region"), None
 
 
 def _gather_bwd(axis_name, _, dy):
@@ -135,7 +138,7 @@ def _scatter_sp_fwd(x, axis_name):
 
 
 def _scatter_sp_bwd(axis_name, _, dy):
-    return (_all_gather(dy, 0, axis_name),)
+    return (_all_gather(dy, 0, axis_name, site="sp.scatter_to_region.bwd"),)
 
 
 scatter_to_sequence_parallel_region.defvjp(_scatter_sp_fwd, _scatter_sp_bwd)
@@ -148,16 +151,17 @@ def gather_from_sequence_parallel_region(
     """All-gather dim 0 fwd; bwd reduce-scatters when the consumer is a TP op
     (each rank contributes a partial grad for every token), else plain split
     (ref: ``_GatherFromSequenceParallelRegion``, tensor_parallel_output_grad)."""
-    return _all_gather(x, 0, axis_name)
+    return _all_gather(x, 0, axis_name, site="sp.gather_from_region")
 
 
 def _gather_sp_fwd(x, axis_name, tp_grad):
-    return _all_gather(x, 0, axis_name), None
+    return _all_gather(x, 0, axis_name, site="sp.gather_from_region"), None
 
 
 def _gather_sp_bwd(axis_name, tp_grad, _, dy):
     if tp_grad:
-        return (_reduce_scatter(dy, 0, axis_name),)
+        return (_reduce_scatter(dy, 0, axis_name,
+                                site="sp.gather_from_region.bwd"),)
     return (_split_along(dy, 0, axis_name),)
 
 
@@ -167,15 +171,18 @@ gather_from_sequence_parallel_region.defvjp(_gather_sp_fwd, _gather_sp_bwd)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def reduce_scatter_to_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
     """Reduce-scatter dim 0 fwd, all-gather bwd (ref: ``_ReduceScatterToSequenceParallelRegion``)."""
-    return _reduce_scatter(x, 0, axis_name)
+    return _reduce_scatter(x, 0, axis_name, site="sp.reduce_scatter_to_region")
 
 
 def _rs_sp_fwd(x, axis_name):
-    return _reduce_scatter(x, 0, axis_name), None
+    return _reduce_scatter(
+        x, 0, axis_name, site="sp.reduce_scatter_to_region"
+    ), None
 
 
 def _rs_sp_bwd(axis_name, _, dy):
-    return (_all_gather(dy, 0, axis_name),)
+    return (_all_gather(dy, 0, axis_name,
+                        site="sp.reduce_scatter_to_region.bwd"),)
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_rs_sp_fwd, _rs_sp_bwd)

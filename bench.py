@@ -128,21 +128,38 @@ def _readback_latency() -> float:
     return _LATENCY
 
 
+_CHAIN_SEQ = 0
+_CALIBRATED_CHAINS = []
+
+
 class Chain:
     """One measurable unit: a jitted dynamic-trip-count fori_loop over
-    ``step_fn(state, *invariants) -> state``."""
+    ``step_fn(state, *invariants) -> state``. The jitted runner is tracked by
+    the recompile sentinel under ``bench.chain.<label>`` (the trip count is a
+    traced arg, so a sentinel hit here means the meter's no-recompile
+    contract broke)."""
 
-    def __init__(self, step_fn, state, invariants=()):
+    def __init__(self, step_fn, state, invariants=(), label=None):
+        global _CHAIN_SEQ
+        _CHAIN_SEQ += 1
+        self.label = label or f"chain{_CHAIN_SEQ}"
         self.state = state
         self.inv = tuple(invariants)
 
+        from beforeholiday_tpu.monitor import track_compiles
+
         @jax.jit
-        def run(n, state, *inv):
+        def _jitted(n, state, *inv):
             return jax.lax.fori_loop(0, n, lambda i, s: step_fn(s, *inv), state)
 
+        run = track_compiles(f"bench.chain.{self.label}")(_jitted)
+        # the sentinel wrapper hides jit's cache introspection; keep it
+        # reachable — the meter test pins _cache_size() == 1
+        run._cache_size = _jitted._cache_size
         self.run = run
         self.n = None
         self.per_iter_est = None
+        self.undersized_sample = False
 
     def compile(self):
         out = self.run(jnp.int32(1), self.state, *self.inv)
@@ -165,6 +182,13 @@ class Chain:
         per = max(t / n, 1e-9)
         self.n = max(1, min(int(target_s / per), n_cap))
         self.per_iter_est = per
+        # a chain so cheap that even n_cap iterations fall under half the
+        # sample budget never escapes readback jitter — flag it so the JSON
+        # reader knows the number is noise-prone, don't silently trust it
+        self.undersized_sample = bool(
+            self.n >= n_cap and per * self.n < target_s / 2
+        )
+        _CALIBRATED_CHAINS.append(self)
         return self
 
     def sample(self) -> float:
@@ -1014,15 +1038,30 @@ def main():
         detail["pp_overhead_vs_sequential_cpu8proxy"] = pp_res[
             "pp_overhead_vs_sequential"]
         detail["pp_1f1b_ms_cpu8"] = pp_res["pp_1f1b_ms"]
+        for k in ("bubble_fraction", "engine_bubble_fraction",
+                  "total_ticks", "phase_counts"):
+            if k in pp_res:
+                detail[f"pp_{k}"] = pp_res[k]
         detail["pp_note"] = "schedule-logic proxy on an 8-CPU mesh, not a TPU number"
 
-    # --- guard dispatch counters: what every rung above actually dispatched
-    # (collected LAST so the telemetry covers the whole bench) ---
-    from beforeholiday_tpu.monitor import dispatch_summary
+    # --- guard dispatch + comms + compile counters: what every rung above
+    # actually dispatched/communicated/compiled (collected LAST so the
+    # telemetry covers the whole bench) ---
+    from beforeholiday_tpu.monitor import (
+        comms_summary,
+        compile_summary,
+        dispatch_summary,
+    )
 
     counters = _stage(detail, dispatch_summary)
     if counters is not None:
         detail["dispatch_counters"] = counters
+    comms = _stage(detail, comms_summary)
+    if comms:
+        detail["comms_summary"] = comms
+    compiles = _stage(detail, compile_summary)
+    if compiles is not None:
+        detail["compile_counters"] = compiles
 
     # --- stability gate: pass-2 must agree within 10% on every ratio ---
     unstable = _unstable_keys(detail, pass2)
@@ -1030,6 +1069,9 @@ def main():
         "method": "fori_loop-chained, gen-subtracted, paired; two passes",
         "stable": not unstable,
         "unstable_keys": unstable,
+        "undersized_chains": sorted(
+            c.label for c in _CALIBRATED_CHAINS if c.undersized_sample
+        ),
         "pass2": {k: round(float(v), 3) for k, v in pass2.items()},
     }
     detail["r04_recorded"] = R04_RECORDED

@@ -34,10 +34,12 @@ _SKIP_DIRS = frozenset({"testing", "models"})
 
 # file-scoped sanctioned functions: the monitor exporter's drain path is the
 # ONE host-side readback the observability contract allows (one fetch per
-# logged step, piggybacking on the step's existing scalar readback) — nothing
-# else in monitor/ may sync
+# logged step, piggybacking on the step's existing scalar readback), and the
+# trace recorder's ``export`` is its one file-write path (host dicts only —
+# it never reads a device value) — nothing else in monitor/ may sync
 _SANCTIONED_BY_FILE = {
     "monitor/export.py": frozenset({"drain", "flush", "_fetch"}),
+    "monitor/trace.py": frozenset({"export"}),
 }
 
 # file-scoped waivers for sync points that are part of a documented host-side
@@ -130,15 +132,20 @@ def test_scanner_catches_the_idioms():
 
 def test_monitor_package_is_scanned():
     """monitor/ must be inside the scanner's reach (not under _SKIP_DIRS),
-    and its only file-scoped sanction is the exporter's drain path."""
+    and its only file-scoped sanctions are the exporter's drain path and the
+    trace recorder's write path."""
     monitor_files = sorted(
         p.relative_to(_PKG_ROOT).as_posix()
         for p in (_PKG_ROOT / "monitor").rglob("*.py")
     )
     assert "monitor/metrics.py" in monitor_files
+    assert "monitor/comms.py" in monitor_files
+    assert "monitor/trace.py" in monitor_files
+    assert "monitor/compile.py" in monitor_files
     assert "monitor" not in _SKIP_DIRS
-    assert set(_SANCTIONED_BY_FILE) == {"monitor/export.py"}
+    assert set(_SANCTIONED_BY_FILE) == {"monitor/export.py", "monitor/trace.py"}
     assert _SANCTIONED_BY_FILE["monitor/export.py"] == {"drain", "flush", "_fetch"}
+    assert _SANCTIONED_BY_FILE["monitor/trace.py"] == {"export"}
     # and no monitor file carries a (file, func) waiver — the sanction list
     # above is the entire exception surface for the subsystem
     assert not [k for k in _WAIVED if k[0].startswith("monitor/")]
