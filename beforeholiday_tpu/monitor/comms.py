@@ -86,26 +86,57 @@ def ledger_scope(name: str):
 
 def _payload_bytes(tree: Any) -> Dict[str, int]:
     """Per-dtype local input payload bytes over the pytree's leaves. Works on
-    tracers (shape/dtype are static) and plain Python scalars."""
+    tracers (shape/dtype are static), ``jax.ShapeDtypeStruct`` stand-ins, and
+    plain Python scalars."""
     out: Dict[str, int] = {}
     for leaf in jax.tree_util.tree_leaves(tree):
-        dt = np.dtype(jnp.result_type(leaf))
-        n = math.prod(jnp.shape(leaf))
+        dtype = getattr(leaf, "dtype", None)
+        dt = np.dtype(dtype) if dtype is not None else np.dtype(
+            jnp.result_type(leaf)
+        )
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            shape = jnp.shape(leaf)
+        n = math.prod(shape)
         out[dt.name] = out.get(dt.name, 0) + n * dt.itemsize
     return out
 
 
-def record(kind: str, axis_name: Any, tree: Any, *, site: str) -> None:
+def record(
+    kind: str, axis_name: Any, tree: Any, *, site: str, logical: Any = None
+) -> None:
     """Account one collective call (host-side, trace-time). Wrappers call
-    this; call it directly only for a collective with no wrapper here."""
+    this; call it directly only for a collective with no wrapper here.
+
+    ``tree`` is the operand actually handed to the interconnect, so ``bytes``
+    is always the WIRE payload. A compressed collective (bf16-on-the-wire over
+    a logically-fp32 gradient) passes the uncompressed stand-in via
+    ``logical`` — pass ``jax.ShapeDtypeStruct``s to avoid building dead cast
+    ops — and the row's ``logical_bytes`` then records what the payload WOULD
+    have cost uncompressed. For ordinary collectives
+    ``logical_bytes == bytes``."""
     scope = ".".join(_scope_stack())
     payload = _payload_bytes(tree)
+    wire_total = sum(payload.values())
+    logical_total = (
+        sum(_payload_bytes(logical).values())
+        if logical is not None
+        else wire_total
+    )
     with _LOCK:
         for dtype_name, nbytes in payload.items():
             key = (kind, str(axis_name), dtype_name, site, scope)
-            row = _RECORDS.setdefault(key, {"calls": 0, "bytes": 0})
+            row = _RECORDS.setdefault(
+                key, {"calls": 0, "bytes": 0, "logical_bytes": 0}
+            )
             row["calls"] += 1
             row["bytes"] += nbytes
+            # multi-dtype wire payloads split the logical total
+            # proportionally; the single-dtype case (every compressed call
+            # site here) is exact
+            row["logical_bytes"] += (
+                logical_total * nbytes // wire_total if wire_total else nbytes
+            )
     # mirror into the active timeline (if one is recording) as an instant
     # marker, so the Perfetto view shows WHICH collectives a traced region
     # issued; deferred full-dotted-path import — the package attribute
@@ -125,8 +156,8 @@ def record(kind: str, axis_name: Any, tree: Any, *, site: str) -> None:
 # keyword ``site`` tag; the ledger sees the LOCAL input operand.
 
 
-def psum(x, axis_name, *, site: str, axis_index_groups=None):
-    record("psum", axis_name, x, site=site)
+def psum(x, axis_name, *, site: str, axis_index_groups=None, logical=None):
+    record("psum", axis_name, x, site=site, logical=logical)
     return jax.lax.psum(x, axis_name, axis_index_groups=axis_index_groups)
 
 
@@ -140,15 +171,19 @@ def pmin(x, axis_name, *, site: str, axis_index_groups=None):
     return jax.lax.pmin(x, axis_name, axis_index_groups=axis_index_groups)
 
 
-def all_gather(x, axis_name, *, site: str, axis: int = 0, tiled: bool = False):
-    record("all_gather", axis_name, x, site=site)
+def all_gather(
+    x, axis_name, *, site: str, axis: int = 0, tiled: bool = False,
+    logical=None,
+):
+    record("all_gather", axis_name, x, site=site, logical=logical)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def psum_scatter(
-    x, axis_name, *, site: str, scatter_dimension: int = 0, tiled: bool = False
+    x, axis_name, *, site: str, scatter_dimension: int = 0,
+    tiled: bool = False, logical=None,
 ):
-    record("psum_scatter", axis_name, x, site=site)
+    record("psum_scatter", axis_name, x, site=site, logical=logical)
     return jax.lax.psum_scatter(
         x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
     )
@@ -160,9 +195,10 @@ def ppermute(x, axis_name, perm, *, site: str):
 
 
 def all_to_all(
-    x, axis_name, split_axis, concat_axis, *, site: str, tiled: bool = False
+    x, axis_name, split_axis, concat_axis, *, site: str, tiled: bool = False,
+    logical=None,
 ):
-    record("all_to_all", axis_name, x, site=site)
+    record("all_to_all", axis_name, x, site=site, logical=logical)
     return jax.lax.all_to_all(
         x, axis_name, split_axis, concat_axis, tiled=tiled
     )
@@ -174,8 +210,10 @@ def all_to_all(
 def comms_records() -> List[Dict[str, object]]:
     """Per-key snapshot, one JSON-ready row per distinct
     (kind, axis, dtype, site, scope): ``{"kind", "axis", "dtype", "site",
-    "scope", "calls", "bytes"}``. ``calls``/``bytes`` count trace-time
-    issues (see the module contract for the scan-body multiplier caveat)."""
+    "scope", "calls", "bytes", "logical_bytes"}``. ``calls``/``bytes`` count
+    trace-time issues (see the module contract for the scan-body multiplier
+    caveat); ``bytes`` is the WIRE payload, ``logical_bytes`` the
+    uncompressed equivalent (equal unless the site compresses)."""
     with _LOCK:
         items = [(k, dict(v)) for k, v in _RECORDS.items()]
     return sorted(
@@ -188,6 +226,7 @@ def comms_records() -> List[Dict[str, object]]:
                 "scope": scope,
                 "calls": c["calls"],
                 "bytes": c["bytes"],
+                "logical_bytes": c.get("logical_bytes", c["bytes"]),
             }
             for (kind, axis, dtype, site, scope), c in items
         ),
@@ -197,8 +236,11 @@ def comms_records() -> List[Dict[str, object]]:
 
 def comms_summary() -> List[Dict[str, object]]:
     """Subsystem rollup, one row per site-tag prefix (the segment before the
-    first ``.``): ``{"subsystem", "sites", "calls", "bytes", "by_kind"}`` —
-    the shape ``bench.py``/MULTICHIP embed, mirroring ``dispatch_summary``."""
+    first ``.``): ``{"subsystem", "sites", "calls", "bytes", "logical_bytes",
+    "compression_ratio", "by_kind"}`` — the shape ``bench.py``/MULTICHIP
+    embed, mirroring ``dispatch_summary``. ``bytes`` totals are WIRE traffic
+    (actual ICI cost); ``compression_ratio = logical_bytes / bytes`` is 1.0
+    for uncompressed subsystems and ~2.0 for bf16-on-the-wire over fp32."""
     rows = comms_records()
     by_sub: Dict[str, Dict[str, object]] = {}
     sites_seen: Dict[str, set] = {}
@@ -206,11 +248,12 @@ def comms_summary() -> List[Dict[str, object]]:
         sub = str(r["site"]).split(".", 1)[0]
         row = by_sub.setdefault(
             sub, {"subsystem": sub, "sites": 0, "calls": 0, "bytes": 0,
-                  "by_kind": {}}
+                  "logical_bytes": 0, "by_kind": {}}
         )
         sites_seen.setdefault(sub, set()).add(r["site"])
         row["calls"] += r["calls"]
         row["bytes"] += r["bytes"]
+        row["logical_bytes"] += r["logical_bytes"]
         kind_row = row["by_kind"].setdefault(
             r["kind"], {"calls": 0, "bytes": 0}
         )
@@ -218,6 +261,10 @@ def comms_summary() -> List[Dict[str, object]]:
         kind_row["bytes"] += r["bytes"]
     for sub, row in by_sub.items():
         row["sites"] = len(sites_seen[sub])
+        row["compression_ratio"] = (
+            round(row["logical_bytes"] / row["bytes"], 4)
+            if row["bytes"] else 1.0
+        )
     return sorted(by_sub.values(), key=lambda r: r["subsystem"])
 
 

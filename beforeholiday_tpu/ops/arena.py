@@ -102,13 +102,21 @@ def segment_ids_of(spec: ArenaSpec, idx: jax.Array) -> jax.Array:
     )
 
 
-def make_spec(tensors: Sequence[jax.Array]) -> ArenaSpec:
-    shapes = tuple(tuple(t.shape) for t in tensors)
+@functools.lru_cache(maxsize=4096)
+def _spec_of_shapes(shapes: Tuple[Tuple[int, ...], ...]) -> ArenaSpec:
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
     offsets = tuple(int(x) for x in np.cumsum([0] + sizes[:-1]))
     total = int(sum(sizes))
     padded_total = ((total + TILE - 1) // TILE) * TILE if total else TILE
     return ArenaSpec(shapes=shapes, offsets=offsets, total=total, padded_total=padded_total)
+
+
+def make_spec(tensors: Sequence[jax.Array]) -> ArenaSpec:
+    """Spec for a tensor list. Memoized on the shape tuple, so every caller
+    with the same layout shares ONE ArenaSpec object — repeated steps never
+    re-run the cumsum, and per-spec caches downstream (``_segment_ids_cached``,
+    the per-tensor-norm machinery) hit on identity, not just equality."""
+    return _spec_of_shapes(tuple(tuple(t.shape) for t in tensors))
 
 
 def flatten(tensors: Sequence[jax.Array], dtype=None) -> Tuple[jax.Array, ArenaSpec]:
@@ -167,15 +175,77 @@ def unflatten(flat: jax.Array, spec: ArenaSpec, dtype=None) -> List[jax.Array]:
     return out
 
 
+@functools.lru_cache(maxsize=256)
+def _packer(shapes, dtype_names, out_dtype_name):
+    """Jitted pack executable, memoized on (shapes, dtypes, out dtype).
+
+    Eager callers of :func:`tree_flatten_arena` hit a compiled concat+pad
+    instead of dispatching O(leaves) ops per step; under an outer jit the
+    nested call is a cached sub-jaxpr XLA inlines. This is the "never
+    re-trace the pack" half of the treeapi fix (the other half is the
+    view-path optimizer step that skips packing entirely)."""
+    spec = _spec_of_shapes(shapes)
+    dtype = jnp.dtype(out_dtype_name or dtype_names[0])
+
+    @jax.jit
+    def pack(leaves):
+        flat = (
+            jnp.ravel(leaves[0]).astype(dtype) if len(leaves) == 1
+            else jnp.concatenate([jnp.ravel(t).astype(dtype) for t in leaves])
+        )
+        pad = spec.padded_total - spec.total
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype=dtype)])
+        return flat
+
+    return pack, spec
+
+
 def tree_flatten_arena(tree: Any, dtype=None):
-    """Flatten an arbitrary pytree of arrays into (arena, spec, treedef)."""
+    """Flatten an arbitrary pytree of arrays into (arena, spec, treedef).
+
+    The pack executable and the spec are memoized on (shapes, dtypes) —
+    repeated steps over the same model never re-derive offsets or re-trace
+    the concatenation."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    flat, spec = flatten(leaves, dtype=dtype)
-    return flat, spec, treedef
+    if not leaves:
+        raise ValueError("tree_flatten_arena() requires a non-empty tree")
+    dtype_names = tuple(jnp.dtype(t.dtype).name for t in leaves)
+    if dtype is None and len(set(dtype_names)) > 1:
+        raise ValueError(
+            f"mixed dtypes in arena ({sorted(set(dtype_names))}); bucket by "
+            "dtype first (ref: apex/parallel/distributed.py:241-244) or "
+            "pass dtype="
+        )
+    pack, spec = _packer(
+        tuple(tuple(t.shape) for t in leaves),
+        dtype_names,
+        jnp.dtype(dtype).name if dtype is not None else None,
+    )
+    return pack(leaves), spec, treedef
 
 
 def tree_unflatten_arena(flat: jax.Array, spec: ArenaSpec, treedef, dtype=None):
     return jax.tree_util.tree_unflatten(treedef, unflatten(flat, spec, dtype=dtype))
+
+
+def views_to_arena(pieces: Sequence[jax.Array], spec: ArenaSpec, dtype=None) -> jax.Array:
+    """Reassemble per-tensor pieces into a flat padded arena — the inverse of
+    :func:`unflatten` and the write half of the pack-free "view path": the
+    optimizer computes each leaf's update against an arena VIEW, and one
+    fused concatenate writes the new arena in a single pass (XLA fuses the
+    elementwise producers into the concat; nothing materializes per leaf)."""
+    if len(pieces) != len(spec.shapes):
+        raise ValueError(
+            f"{len(pieces)} pieces for a {len(spec.shapes)}-tensor spec"
+        )
+    if dtype is None:
+        dtype = pieces[0].dtype
+    parts = [jnp.ravel(p).astype(dtype) for p in pieces]
+    pad = spec.padded_total - spec.total
+    if pad:
+        parts.append(jnp.zeros((pad,), dtype=dtype))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
 
 def as_rows(flat: jax.Array) -> jax.Array:

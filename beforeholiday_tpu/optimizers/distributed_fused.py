@@ -19,6 +19,13 @@ XLA's latency-hiding scheduler overlaps the collectives with surrounding
 compute — the stream pipelining the reference hand-builds. All functions run
 inside ``shard_map`` with the data axis bound (``check_vma=False``), taking
 *local unreduced* grads exactly like ``reduce_gradients``.
+
+``bucket_bytes``/``compress`` split both transfers into independent
+~bucket_bytes collectives (``parallel.bucketing``) — the XLA analogue of the
+reference's pipelined reduce-scatter/all-gather streams (:302) — optionally
+with a ``wire_dtype`` (bf16) on the wire and fp32 accumulation. Grads may
+arrive as a ``PackedParams`` whose arena layout matches the params: then the
+reduce-scatter consumes the flat arena directly, no per-step tree flatten.
 """
 
 from __future__ import annotations
@@ -30,7 +37,10 @@ import jax.numpy as jnp
 
 from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.ops import multi_tensor as mt
-from beforeholiday_tpu.ops.arena import TILE, flatten, make_spec, unflatten
+from beforeholiday_tpu.ops.arena import (
+    TILE, PackedParams, flatten, make_spec, unflatten,
+)
+from beforeholiday_tpu.parallel import bucketing
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
 
 
@@ -49,12 +59,23 @@ def _pad_to(flat: jax.Array, n: int) -> jax.Array:
 class _DistributedFused:
     """Shared arena/collective machinery for the sharded optimizers."""
 
-    def __init__(self, *, axis_name: str = DATA_AXIS, grad_average: bool = True):
+    def __init__(
+        self,
+        *,
+        axis_name: str = DATA_AXIS,
+        grad_average: bool = True,
+        bucket_bytes: Optional[int] = None,
+        compress: bool = False,
+        wire_dtype: Any = jnp.bfloat16,
+    ):
         self.axis_name = axis_name
         self.grad_average = grad_average
+        self.bucket_bytes = bucket_bytes
+        self.compress = compress
+        self.wire_dtype = wire_dtype
 
     def _world(self):
-        return jax.lax.axis_size(self.axis_name)
+        return bucketing.static_axis_size(self.axis_name)
 
     def _arena_layout(self, params) -> Tuple[Any, Any, int, int]:
         leaves, treedef = jax.tree_util.tree_flatten(params)
@@ -90,12 +111,25 @@ class _DistributedFused:
         return state
 
     def _reduce_scatter_grads(self, grads, spec, shard):
-        gleaves = jax.tree_util.tree_leaves(grads)
-        gflat, _ = flatten(gleaves, dtype=jnp.float32)
+        if isinstance(grads, PackedParams):
+            lay = grads.layout
+            if len(grads.arenas) == 1 and lay.specs[0].shapes == spec.shapes:
+                # arena-native grads with the optimizer's own layout: the flat
+                # buffer IS the reduce-scatter operand, zero per-step packing
+                gflat = grads.arenas[0].astype(jnp.float32)
+            else:
+                # mixed-dtype packing orders leaves per dtype bucket — fall
+                # back through the leaf views to restore params order
+                gleaves = jax.tree_util.tree_leaves(grads.unpack())
+                gflat, _ = flatten(gleaves, dtype=jnp.float32)
+        else:
+            gleaves = jax.tree_util.tree_leaves(grads)
+            gflat, _ = flatten(gleaves, dtype=jnp.float32)
         gflat = _pad_to(gflat, shard * self._world())
-        g_shard = comms.psum_scatter(
+        g_shard = bucketing.bucketed_psum_scatter(
             gflat, self.axis_name, site="zero2.reduce_scatter_grads",
-            scatter_dimension=0, tiled=True
+            bucket_bytes=self.bucket_bytes, compress=self.compress,
+            wire_dtype=self.wire_dtype,
         )
         if self.grad_average:
             g_shard = g_shard / self._world()
@@ -103,9 +137,28 @@ class _DistributedFused:
 
     def _gather_params(self, master_shard, params, spec):
         leaves = jax.tree_util.tree_leaves(params)
+        if self.bucket_bytes is None and not self.compress:
+            pieces = self._gather_full(master_shard, spec)
+        else:
+            # bucketed re-materialization: independent per-bucket gathers XLA
+            # double-buffers against the consumers of already-landed buckets
+            # (ref: distributed_fused_adam.py:1071-1076 pipelined all-gather).
+            # compress puts wire_dtype on the wire; the masters stay fp32, so
+            # the rounding hits only the model copy — same contract as
+            # MasterWeights' low-precision model params.
+            wire = master_shard
+            logical_dtype = None
+            if self.compress:
+                wire = master_shard.astype(self.wire_dtype)
+                logical_dtype = master_shard.dtype
+            full = bucketing.bucketed_all_gather(
+                wire, self.axis_name, site="zero2.gather_params",
+                bucket_bytes=self.bucket_bytes, logical_dtype=logical_dtype,
+            )
+            pieces = unflatten(full[: spec.padded_total], spec)
         new_leaves = [
             piece.astype(leaf.dtype)
-            for piece, leaf in zip(self._gather_full(master_shard, spec), leaves)
+            for piece, leaf in zip(pieces, leaves)
         ]
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params), new_leaves
@@ -166,9 +219,16 @@ class DistributedFusedAdam(_DistributedFused):
         bias_correction: bool = True,
         axis_name: str = DATA_AXIS,
         grad_average: bool = True,
+        bucket_bytes: Optional[int] = None,
+        compress: bool = False,
+        wire_dtype: Any = jnp.bfloat16,
         impl: Optional[str] = None,
     ):
-        super().__init__(axis_name=axis_name, grad_average=grad_average)
+        super().__init__(
+            axis_name=axis_name, grad_average=grad_average,
+            bucket_bytes=bucket_bytes, compress=compress,
+            wire_dtype=wire_dtype,
+        )
         self.lr, self.betas, self.eps = lr, betas, eps
         self.adam_w_mode = adam_w_mode
         self.weight_decay = weight_decay
@@ -220,9 +280,16 @@ class DistributedFusedLAMB(_DistributedFused):
         use_nvlamb: bool = False,
         axis_name: str = DATA_AXIS,
         grad_average: bool = True,
+        bucket_bytes: Optional[int] = None,
+        compress: bool = False,
+        wire_dtype: Any = jnp.bfloat16,
         impl: Optional[str] = None,
     ):
-        super().__init__(axis_name=axis_name, grad_average=grad_average)
+        super().__init__(
+            axis_name=axis_name, grad_average=grad_average,
+            bucket_bytes=bucket_bytes, compress=compress,
+            wire_dtype=wire_dtype,
+        )
         self.lr, self.betas, self.eps = lr, betas, eps
         self.weight_decay = weight_decay
         self.bias_correction = bias_correction

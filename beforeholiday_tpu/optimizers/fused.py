@@ -21,10 +21,12 @@ step an identity and holds the step counter — the device-side skip-step
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from beforeholiday_tpu.monitor.spans import annotate
 from beforeholiday_tpu.ops import multi_tensor as mt
@@ -33,7 +35,9 @@ from beforeholiday_tpu.ops.arena import (
     PackedParams,
     bucket_by_dtype as _bucket_by_dtype,
     flatten as _arena_flatten,
+    make_spec as _make_spec,
     unflatten as _arena_unflatten,
+    views_to_arena as _views_to_arena,
 )
 from beforeholiday_tpu.ops._autocast import cast_floats as _cast_floats
 
@@ -69,6 +73,15 @@ def _buckets(pleaves, gleaves, nowd_flags) -> Dict[tuple, List[int]]:
     for i, (p, g, nowd) in enumerate(zip(pleaves, gleaves, nowd_flags)):
         out.setdefault((p.dtype, g.dtype, nowd), []).append(i)
     return out
+
+
+@functools.lru_cache(maxsize=4096)
+def _single_tensor_spec(shape: Tuple[int, ...]) -> ArenaSpec:
+    # unpadded one-tensor spec for the view path's per-leaf LAMB norms: the
+    # leaf IS the whole "arena", so total == padded_total (no TILE rounding —
+    # nothing here feeds a Pallas kernel)
+    n = int(np.prod(shape)) if shape else 1
+    return ArenaSpec(shapes=(shape,), offsets=(0,), total=n, padded_total=n)
 
 
 def _gather(leaves, idx):
@@ -145,12 +158,35 @@ class _FusedOptimizer:
                   found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None):
         """One fused step over pre-flattened arenas.
 
-        Returns ``(flat_params, state)``, plus a low-precision model copy
-        (same kernel pass, see ops.adam_flat) when ``model_copy_dtype`` is set.
+        ``flat_grads`` is either a flat arena matching ``flat_params`` OR a
+        leaf LIST (the pack-free "view path": each grad leaf updates against
+        an arena view and one fused concatenate writes the new arenas — the
+        tree-grads caller never pays a per-step gradient pack). Returns
+        ``(flat_params, state)``, plus a low-precision model copy (same
+        kernel pass, see ops.adam_flat) when ``model_copy_dtype`` is set —
+        a flat arena on the arena path, a list of leaf-shaped pieces on the
+        view path.
         """
         raise NotImplementedError(
             f"{type(self).__name__} has no flat-arena step; use step()"
         )
+
+    def _view_setup(self, flat_params, flat_grads, spec):
+        """Prologue shared by the view-path steps: resolve/validate the spec
+        against the grad leaf list (memoized — repeated steps re-derive
+        nothing)."""
+        gleaves = list(flat_grads)
+        if not gleaves:
+            raise ValueError("view-path step_flat needs a non-empty grad list")
+        if spec is None:
+            spec = _make_spec(gleaves)
+        if flat_params.shape[0] != spec.padded_total:
+            raise ValueError(
+                f"param arena spans {flat_params.shape[0]} elements but the "
+                f"grad leaf list spans {spec.padded_total} (padded) — "
+                "grads must cover exactly the packed parameters"
+            )
+        return gleaves, spec
 
     def as_optax(self):
         """Adapter to an ``optax.GradientTransformation`` (fp32 use)."""
@@ -233,6 +269,12 @@ class FusedAdam(_FusedOptimizer):
     @annotate("fused_adam_step_flat")
     def step_flat(self, flat_params, flat_grads, state, *, spec=None,
                   found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None):
+        if isinstance(flat_grads, (list, tuple)):
+            return self._step_views(
+                flat_params, flat_grads, state, spec=spec,
+                found_inf=found_inf, grad_scale=grad_scale, lr=lr,
+                model_copy_dtype=model_copy_dtype,
+            )
         lr = self.lr if lr is None else lr
         step_no = self._next_step(state, found_inf)
         outs = mt.adam_flat(
@@ -247,6 +289,45 @@ class FusedAdam(_FusedOptimizer):
         if model_copy_dtype is None:
             return outs[0], new_state
         return outs[0], new_state, outs[3]
+
+    def _step_views(self, flat_params, flat_grads, state, *, spec,
+                    found_inf, grad_scale, lr, model_copy_dtype):
+        """Pack-free tree-grads step: per-leaf elementwise math against arena
+        views, one fused concatenate per output arena (XLA fuses the
+        producers into the write — no materialized gradient arena, no pack).
+        Always the jnp lowering: fusion IS the fast path here; a per-leaf
+        Pallas launch would reintroduce O(leaves) kernel dispatches."""
+        gleaves, spec = self._view_setup(flat_params, flat_grads, spec)
+        lr = self.lr if lr is None else lr
+        step_no = self._next_step(state, found_inf)
+        p_views = _arena_unflatten(flat_params, spec)
+        m_views = _arena_unflatten(state["exp_avg"], spec)
+        v_views = _arena_unflatten(state["exp_avg_sq"], spec)
+        new_p, new_m, new_v, copies = [], [], [], []
+        for g, p, m, v in zip(gleaves, p_views, m_views, v_views):
+            outs = mt.adam_flat(
+                g.reshape(p.shape), p, m, v,
+                lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+                step=step_no, adam_w_mode=self.adam_w_mode,
+                bias_correction=self.bias_correction,
+                weight_decay=self.weight_decay, grad_scale=grad_scale,
+                found_inf=found_inf, model_copy_dtype=model_copy_dtype,
+                impl="jnp",
+            )
+            new_p.append(outs[0])
+            new_m.append(outs[1])
+            new_v.append(outs[2])
+            if model_copy_dtype is not None:
+                copies.append(outs[3])
+        new_state = {
+            "exp_avg": _views_to_arena(new_m, spec),
+            "exp_avg_sq": _views_to_arena(new_v, spec),
+            "step": step_no,
+        }
+        new_flat = _views_to_arena(new_p, spec, dtype=flat_params.dtype)
+        if model_copy_dtype is None:
+            return new_flat, new_state
+        return new_flat, new_state, copies
 
 
 class FusedSGD(_FusedOptimizer):
@@ -308,6 +389,12 @@ class FusedSGD(_FusedOptimizer):
     @annotate("fused_sgd_step_flat")
     def step_flat(self, flat_params, flat_grads, state, *, spec=None,
                   found_inf=None, grad_scale=1.0, lr=None, model_copy_dtype=None):
+        if isinstance(flat_grads, (list, tuple)):
+            return self._step_views(
+                flat_params, flat_grads, state, spec=spec,
+                found_inf=found_inf, grad_scale=grad_scale, lr=lr,
+                model_copy_dtype=model_copy_dtype,
+            )
         lr = self.lr if lr is None else lr
         first_run = state["step"] == 0
         step_no = self._next_step(state, found_inf)
@@ -323,6 +410,39 @@ class FusedSGD(_FusedOptimizer):
         if model_copy_dtype is None:
             return outs[0], new_state
         return outs[0], new_state, outs[2]
+
+    def _step_views(self, flat_params, flat_grads, state, *, spec,
+                    found_inf, grad_scale, lr, model_copy_dtype):
+        """Pack-free tree-grads step (see FusedAdam._step_views)."""
+        gleaves, spec = self._view_setup(flat_params, flat_grads, spec)
+        lr = self.lr if lr is None else lr
+        first_run = state["step"] == 0
+        step_no = self._next_step(state, found_inf)
+        p_views = _arena_unflatten(flat_params, spec)
+        b_views = _arena_unflatten(state["momentum_buffer"], spec)
+        new_p, new_b, copies = [], [], []
+        for g, p, b in zip(gleaves, p_views, b_views):
+            outs = mt.sgd_flat(
+                g.reshape(p.shape), p, b,
+                lr=lr, weight_decay=self.weight_decay,
+                momentum=self.momentum, dampening=self.dampening,
+                nesterov=self.nesterov, first_run=first_run,
+                wd_after_momentum=self.wd_after_momentum, scale=grad_scale,
+                model_copy_dtype=model_copy_dtype, found_inf=found_inf,
+                impl="jnp",
+            )
+            new_p.append(outs[0])
+            new_b.append(outs[1])
+            if model_copy_dtype is not None:
+                copies.append(outs[2])
+        new_state = {
+            "momentum_buffer": _views_to_arena(new_b, spec),
+            "step": step_no,
+        }
+        new_flat = _views_to_arena(new_p, spec, dtype=flat_params.dtype)
+        if model_copy_dtype is None:
+            return new_flat, new_state
+        return new_flat, new_state, copies
 
 
 class FusedAdagrad(_FusedOptimizer):
@@ -464,6 +584,13 @@ class FusedLAMB(_FusedOptimizer):
         parameter set spans several arenas (MasterWeights arena mode computes
         it) — defaulting to this arena's own norm is only correct when the
         arena IS the whole model."""
+        if isinstance(flat_grads, (list, tuple)):
+            return self._step_views(
+                flat_params, flat_grads, state, spec=spec,
+                found_inf=found_inf, grad_scale=grad_scale, lr=lr,
+                model_copy_dtype=model_copy_dtype,
+                global_grad_norm=global_grad_norm,
+            )
         if spec is None:
             raise ValueError("FusedLAMB.step_flat needs the ArenaSpec for its "
                              "per-tensor trust-ratio norms")
@@ -486,6 +613,57 @@ class FusedLAMB(_FusedOptimizer):
         if model_copy_dtype is None:
             return outs[0], new_state
         return outs[0], new_state, outs[3]
+
+    def _step_views(self, flat_params, flat_grads, state, *, spec,
+                    found_inf, grad_scale, lr, model_copy_dtype,
+                    global_grad_norm):
+        """Pack-free tree-grads step (see FusedAdam._step_views). LAMB's
+        per-tensor trust ratios come from one unpadded single-tensor spec per
+        leaf; the global clip norm spans ALL leaves, matching the arena
+        path's whole-arena norm."""
+        gleaves, spec = self._view_setup(flat_params, flat_grads, spec)
+        lr = self.lr if lr is None else lr
+        step_no = self._next_step(state, found_inf)
+        # fold the inverse loss scale before the global-norm clip, exactly as
+        # the arena path does (grad_scale enters the norm there too)
+        g32 = [g.astype(jnp.float32) * grad_scale for g in gleaves]
+        if global_grad_norm is None:
+            global_grad_norm = jnp.sqrt(
+                sum(jnp.sum(g * g) for g in g32)
+            )
+        p_views = _arena_unflatten(flat_params, spec)
+        m_views = _arena_unflatten(state["exp_avg"], spec)
+        v_views = _arena_unflatten(state["exp_avg_sq"], spec)
+        new_p, new_m, new_v, copies = [], [], [], []
+        for g, p, m, v in zip(g32, p_views, m_views, v_views):
+            leaf_spec = _single_tensor_spec(tuple(p.shape))
+            n = leaf_spec.total
+            outs = mt.lamb_flat(
+                g.reshape(n), p.reshape(n), m.reshape(n), v.reshape(n),
+                leaf_spec,
+                lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+                step=step_no, bias_correction=self.bias_correction,
+                weight_decay=self.weight_decay,
+                grad_averaging=self.grad_averaging,
+                mode=1 if self.adam_w_mode else 0,
+                max_grad_norm=self.max_grad_norm, use_nvlamb=self.use_nvlamb,
+                found_inf=found_inf, global_grad_norm=global_grad_norm,
+                model_copy_dtype=model_copy_dtype, impl="jnp",
+            )
+            new_p.append(outs[0].reshape(p.shape))
+            new_m.append(outs[1].reshape(p.shape))
+            new_v.append(outs[2].reshape(p.shape))
+            if model_copy_dtype is not None:
+                copies.append(outs[3].reshape(p.shape))
+        new_state = {
+            "exp_avg": _views_to_arena(new_m, spec),
+            "exp_avg_sq": _views_to_arena(new_v, spec),
+            "step": step_no,
+        }
+        new_flat = _views_to_arena(new_p, spec, dtype=flat_params.dtype)
+        if model_copy_dtype is None:
+            return new_flat, new_state
+        return new_flat, new_state, copies
 
 
 class FusedNovoGrad(_FusedOptimizer):
@@ -747,6 +925,10 @@ class MasterWeights:
         return new_params, {"inner": tuple(inners), "master": tuple(masters)}
 
     def _step_arena(self, params, grads, state, *, found_inf=None, grad_scale=1.0, **kw):
+        # the grads stay a LEAF LIST all the way into step_flat's view path —
+        # the former per-step gradient flatten (one extra arena-sized HBM
+        # round trip, the 0.54x-vs-optax treeapi regression) is gone; only
+        # the masters/optimizer state live flat, packed once at init
         pleaves, treedef = jax.tree_util.tree_flatten(params)
         gleaves = jax.tree_util.tree_leaves(grads)
         if len(pleaves) != len(gleaves):
@@ -754,26 +936,30 @@ class MasterWeights:
                 f"params/grads leaf mismatch: {len(pleaves)} vs {len(gleaves)}"
             )
         layout = self._bucket_layout(pleaves)
-        flat_grads = [
-            _arena_flatten([gleaves[i] for i in idx]) for _, idx in layout
-        ]
-        extra = self._global_norm_extra([gf for gf, _ in flat_grads], grad_scale)
+        bucket_grads = [[gleaves[i] for i in idx] for _, idx in layout]
+        extra = self._global_norm_extra(
+            [g for sub in bucket_grads for g in sub], grad_scale
+        )
 
         new_leaves = list(pleaves)
         masters, inners = [], []
         for b, (dtype, idx) in enumerate(layout):
-            # grads keep the model dtype — the kernel casts in-register
-            gf, spec = flat_grads[b]
+            # grads keep the model dtype — the view path casts in-register
+            spec = _make_spec(bucket_grads[b])
             copy_dtype = None if dtype == jnp.float32 else dtype
             outs = self.inner.step_flat(
-                state["master"][b], gf, state["inner"][b], spec=spec,
-                found_inf=found_inf, grad_scale=grad_scale,
+                state["master"][b], bucket_grads[b], state["inner"][b],
+                spec=spec, found_inf=found_inf, grad_scale=grad_scale,
                 model_copy_dtype=copy_dtype, **extra, **kw,
             )
             masters.append(outs[0])
             inners.append(outs[1])
-            model_flat = outs[2] if copy_dtype is not None else outs[0]
-            for i, piece in zip(idx, _arena_unflatten(model_flat, spec)):
+            # view path hands the model copy back as leaf-shaped pieces
+            pieces = (
+                outs[2] if copy_dtype is not None
+                else _arena_unflatten(outs[0], spec)
+            )
+            for i, piece in zip(idx, pieces):
                 new_leaves[i] = piece
         new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
         return new_params, {"inner": tuple(inners), "master": tuple(masters)}

@@ -6,7 +6,11 @@ apex/transformer/parallel_state.py:81-682). NCCL process groups become named axe
 `jax.sharding.Mesh`; bucketed allreduce becomes `lax.psum` over the ``data`` axis.
 """
 
-from beforeholiday_tpu.parallel import parallel_state
+from beforeholiday_tpu.parallel import bucketing, parallel_state
+from beforeholiday_tpu.parallel.bucketing import (
+    DEFAULT_BUCKET_BYTES,
+    BucketedReduce,
+)
 from beforeholiday_tpu.parallel.distributed import (
     DistributedDataParallel,
     Reducer,
@@ -32,6 +36,9 @@ from beforeholiday_tpu.parallel.parallel_state import (
 
 __all__ = [
     "parallel_state",
+    "bucketing",
+    "BucketedReduce",
+    "DEFAULT_BUCKET_BYTES",
     "DistributedDataParallel",
     "Reducer",
     "reduce_gradients",

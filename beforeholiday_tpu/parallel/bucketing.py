@@ -1,0 +1,517 @@
+"""Bucketed + dtype-compressed collectives over flat gradient arenas.
+
+Heritage: Apex's ``DistributedDataParallel`` splits gradients into
+``allreduce_communicators`` buckets so NCCL all-reduces overlap with the rest
+of backward (apex/parallel/distributed.py), and ZeRO shards the reduction as
+a reduce-scatter (Rajbhandari et al., 2020). Under jit the overlap mechanism
+is different — XLA's latency-hiding scheduler interleaves collectives with
+compute on its own — but it can only overlap INDEPENDENT ops. One monolithic
+psum over a 46M-param arena is a single serialized blob; this module slices
+the same arena into right-sized buckets issued as independent collectives the
+scheduler is free to hoist between the remaining backward work.
+
+Three guarantees every helper here keeps:
+
+* **Static geometry.** Bucket offsets/lengths and the axis size are host
+  Python ints derived at trace time (``static_axis_size`` exploits that
+  ``psum(1, axis)`` is static under ``shard_map``); nothing here branches on
+  a traced value and nothing reads back to the host
+  (``tests/test_no_host_sync.py`` scans this file).
+* **fp32 accumulation under compression.** ``compress=True`` casts each
+  bucket to the wire dtype ONCE, exchanges rank-major rows via
+  ``all_to_all`` (a reduce-scatter in disguise), and sums the received rows
+  in fp32 — the reduction tree itself never rounds in bf16. The elementwise
+  error versus the exact fp32 reduce is bounded by
+  ``wire_eps(wire_dtype) * psum(|x|)`` — one input rounding per rank plus
+  (for the all-reduce form) one output rounding of the fp32 sum.
+* **Ledger-visible.** Every collective routes through
+  ``monitor.comms`` wrappers: per-site ``calls`` is the bucket count,
+  ``bytes`` the actual wire payload (bf16 when compressed), and
+  ``logical_bytes``/``compression_ratio`` quantify what compression saved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from beforeholiday_tpu.monitor import comms
+from beforeholiday_tpu.ops.arena import LANES
+from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
+
+__all__ = [
+    "BucketedReduce",
+    "DEFAULT_BUCKET_BYTES",
+    "bucket_slices",
+    "bucketed_all_gather",
+    "bucketed_psum",
+    "bucketed_psum_scatter",
+    "bucketed_tree_psum",
+    "chunked_all_gather",
+    "chunked_reduce_scatter",
+    "compression_error_bound",
+    "n_buckets",
+    "partition_leaves",
+    "static_axis_size",
+    "wire_eps",
+]
+
+# ~4 MiB: large enough that per-collective launch latency amortizes, small
+# enough that several buckets are in flight while backward still computes
+# (same sweet spot Apex and PyTorch DDP converged on: 25 MB default there is
+# for NVLink-size links; ICI latency is lower, so buckets can be smaller)
+DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+
+# unit roundoff of the supported wire dtypes (2^-(mantissa_bits + 1))
+_WIRE_EPS = {"bfloat16": 2.0 ** -8, "float16": 2.0 ** -11}
+
+
+def wire_eps(wire_dtype: Any) -> float:
+    """Unit roundoff of a supported wire dtype (bf16: 2^-8, fp16: 2^-11)."""
+    name = np.dtype(wire_dtype).name
+    try:
+        return _WIRE_EPS[name]
+    except KeyError:
+        raise ValueError(
+            f"unsupported wire dtype {name!r}; use bfloat16 or float16"
+        ) from None
+
+
+def compression_error_bound(sum_abs, wire_dtype: Any = jnp.bfloat16):
+    """Elementwise analytic bound on ``|compressed_reduce - exact_reduce|``.
+
+    ``sum_abs`` is ``psum(|x|)`` (the cross-rank sum of absolute values).
+    Each rank's contribution rounds once on the wire (relative error <=
+    ``wire_eps``), the accumulation is exact in fp32, and the all-reduce form
+    adds one more wire rounding of the result — both effects are covered by
+    ``2 * wire_eps * sum_abs``; the reduce-scatter form (result stays fp32)
+    is within ``wire_eps * sum_abs``. This returns the looser all-reduce
+    bound."""
+    return 2.0 * wire_eps(wire_dtype) * sum_abs
+
+
+def static_axis_size(axis_name: Any) -> int:
+    """The mesh axis size as a host Python int, inside a ``shard_map`` trace.
+
+    ``lax.axis_size`` where it exists (jax >= 0.6); otherwise
+    ``psum(1, axis)`` — on the old API a psum of a Python constant folds to a
+    static int at trace time, which is exactly what bucket geometry needs."""
+    size_fn = getattr(jax.lax, "axis_size", None)
+    size = size_fn(axis_name) if size_fn is not None else jax.lax.psum(
+        1, axis_name
+    )
+    try:
+        return int(size)
+    except Exception as exc:  # tracer leak: geometry would become dynamic
+        raise ValueError(
+            f"axis {axis_name!r} has no static size under this trace; "
+            "bucketed collectives need static bucket geometry"
+        ) from exc
+
+
+@functools.lru_cache(maxsize=4096)
+def bucket_slices(
+    n: int,
+    itemsize: int,
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    align: int = LANES,
+) -> Tuple[Tuple[int, int], ...]:
+    """Static (offset, length) covering ``[0, n)`` in ~``bucket_bytes`` steps.
+
+    Offsets are multiples of ``align`` (LANES keeps arena slices on lane
+    boundaries so the 2D row-view trick below applies); only the final bucket
+    may be ragged. ``bucket_bytes=None`` means one bucket."""
+    if n <= 0:
+        raise ValueError(f"cannot bucket an empty payload (n={n})")
+    if bucket_bytes is None:
+        return ((0, n),)
+    per = max(int(bucket_bytes) // int(itemsize), 1)
+    per = max(per - per % align, align)
+    return tuple((off, min(per, n - off)) for off in range(0, n, per))
+
+
+def n_buckets(
+    n_elements: int,
+    itemsize: int,
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+) -> int:
+    """How many buckets a payload splits into (for bench/ledger reporting)."""
+    return len(bucket_slices(n_elements, itemsize, bucket_bytes))
+
+
+def _slice_flat(flat, off: int, ln: int):
+    # LANES-aligned slices go through a (rows, LANES) view: row slices of a
+    # 2D array keep the TPU tiled layout trivial, where a large 1D slice can
+    # force a relayout pass (same hazard ops.arena.unflatten documents)
+    if off % LANES == 0 and ln % LANES == 0 and flat.shape[0] % LANES == 0:
+        rows = flat.reshape(flat.shape[0] // LANES, LANES)
+        piece = jax.lax.slice_in_dim(
+            rows, off // LANES, (off + ln) // LANES, axis=0
+        )
+        return piece.reshape(ln)
+    return jax.lax.slice_in_dim(flat, off, off + ln, axis=0)
+
+
+def _logical(shape: Tuple[int, ...], dtype: Any) -> jax.ShapeDtypeStruct:
+    # ledger stand-in for "what this payload would cost uncompressed" — a
+    # ShapeDtypeStruct so no dead cast op enters the trace
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _compressed_allreduce(x, axis_name, *, site: str, wire_dtype):
+    """2-shot compressed all-reduce of a 1D bucket with fp32 accumulation.
+
+    Phase 1 is a reduce-scatter spelled as ``all_to_all`` over a rank-major
+    (world, chunk) view — spelling it that way is what lets each rank do the
+    accumulation itself in fp32 (a compressed ``psum_scatter`` would round in
+    the wire dtype at every reduction hop). Phase 2 re-shares the reduced
+    chunks with one more wire cast. Returns fp32."""
+    world = static_axis_size(axis_name)
+    n = x.shape[0]
+    chunk = -(-n // world)
+    pad = chunk * world - n
+    xp = jnp.pad(x, (0, pad)) if pad else x
+    wire = xp.reshape(world, chunk).astype(wire_dtype)
+    recv = comms.all_to_all(
+        wire, axis_name, 0, 0, site=site,
+        logical=_logical(wire.shape, x.dtype),
+    )
+    acc = jnp.sum(recv.astype(jnp.float32), axis=0)
+    back = comms.all_gather(
+        acc.astype(wire_dtype), axis_name, axis=0, tiled=True, site=site,
+        logical=_logical(acc.shape, jnp.float32),
+    )
+    out = back.astype(jnp.float32)
+    return out[:n] if pad else out
+
+
+def bucketed_psum(
+    flat,
+    axis_name: Any,
+    *,
+    site: str,
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    compress: bool = False,
+    wire_dtype: Any = jnp.bfloat16,
+):
+    """All-reduce a flat (1D) arena in independent per-bucket collectives.
+
+    Uncompressed buckets are plain ``psum`` slices — bitwise identical to the
+    monolithic ``psum`` regardless of bucket size. ``compress=True`` sends
+    each bucket over the wire in ``wire_dtype`` with fp32 accumulation (see
+    module docstring for the error bound) and returns in the input dtype."""
+    if flat.ndim != 1:
+        raise ValueError(f"bucketed_psum wants a flat arena, got {flat.shape}")
+    if not compress and bucket_bytes is None:
+        return comms.psum(flat, axis_name, site=site)
+    slices = bucket_slices(flat.shape[0], flat.dtype.itemsize, bucket_bytes)
+    pieces = []
+    for off, ln in slices:
+        piece = _slice_flat(flat, off, ln)
+        if compress:
+            piece = _compressed_allreduce(
+                piece, axis_name, site=site, wire_dtype=wire_dtype
+            ).astype(flat.dtype)
+        else:
+            piece = comms.psum(piece, axis_name, site=site)
+        pieces.append(piece)
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
+def bucketed_psum_scatter(
+    flat,
+    axis_name: Any,
+    *,
+    site: str,
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    compress: bool = False,
+    wire_dtype: Any = jnp.bfloat16,
+):
+    """Reduce-scatter a (world*shard,) arena into this rank's (shard,) piece.
+
+    Bucketing runs along SHARD columns of the rank-major (world, shard) view,
+    so concatenating per-bucket results reconstructs the rank's contiguous
+    shard — per-bucket collectives stay independent AND shard ownership stays
+    contiguous (what the ZeRO-2 optimizer step indexes into). Compressed
+    buckets do the all_to_all + local-fp32-sum exchange and never leave fp32
+    on the reduction path (output cast back to the input dtype, a no-op for
+    fp32 arenas)."""
+    world = static_axis_size(axis_name)
+    total = flat.shape[0]
+    if flat.ndim != 1 or total % world:
+        raise ValueError(
+            f"bucketed_psum_scatter wants a flat arena divisible by the axis "
+            f"size, got shape {flat.shape} over world={world}"
+        )
+    if not compress and bucket_bytes is None:
+        return comms.psum_scatter(
+            flat, axis_name, scatter_dimension=0, tiled=True, site=site
+        )
+    shard = total // world
+    mat = flat.reshape(world, shard)
+    # a shard column costs world*itemsize wire bytes, so budget per column
+    slices = bucket_slices(shard, flat.dtype.itemsize * world, bucket_bytes)
+    pieces = []
+    for off, ln in slices:
+        col = jax.lax.slice_in_dim(mat, off, off + ln, axis=1)
+        if compress:
+            wire = col.astype(wire_dtype)
+            recv = comms.all_to_all(
+                wire, axis_name, 0, 0, site=site,
+                logical=_logical(wire.shape, flat.dtype),
+            )
+            piece = jnp.sum(recv.astype(jnp.float32), axis=0).astype(
+                flat.dtype
+            )
+        else:
+            piece = comms.psum_scatter(
+                col.reshape(world * ln), axis_name, scatter_dimension=0,
+                tiled=True, site=site,
+            )
+        pieces.append(piece)
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
+def bucketed_all_gather(
+    shard,
+    axis_name: Any,
+    *,
+    site: str,
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    logical_dtype: Any = None,
+):
+    """All-gather per-rank (shard,) pieces into the rank-major (world*shard,).
+
+    Issued as independent per-bucket gathers (the double-buffering the ZeRO
+    param re-materialization wants: XLA can overlap bucket k's gather with
+    bucket k-1's consumer). The caller owns any wire cast — pass
+    ``logical_dtype`` so the ledger still knows the uncompressed cost."""
+    world = static_axis_size(axis_name)
+    n = shard.shape[0]
+    logical = (
+        None if logical_dtype is None
+        else _logical(shard.shape, logical_dtype)
+    )
+    if shard.ndim != 1:
+        raise ValueError(
+            f"bucketed_all_gather wants a flat shard, got {shard.shape}"
+        )
+    slices = bucket_slices(n, shard.dtype.itemsize, bucket_bytes)
+    if len(slices) == 1:
+        return comms.all_gather(
+            shard, axis_name, axis=0, tiled=True, site=site, logical=logical
+        )
+    parts = []
+    for off, ln in slices:
+        piece = _slice_flat(shard, off, ln)
+        g = comms.all_gather(
+            piece, axis_name, axis=0, tiled=True, site=site,
+            logical=None if logical_dtype is None
+            else _logical(piece.shape, logical_dtype),
+        )
+        parts.append(g.reshape(world, ln))
+    # concatenating along the chunk axis of the (world, ln) views restores
+    # rank-major order, exactly matching the monolithic tiled gather
+    return jnp.concatenate(parts, axis=1).reshape(world * n)
+
+
+# --------------------------------------------------------- ND chunked forms
+# For the tensor-parallel mappings: same independence argument, but over an
+# arbitrary gather/scatter dimension of an activation tensor instead of a
+# flat arena. Both are bitwise-equal to their monolithic counterparts.
+
+
+def chunked_all_gather(
+    x,
+    axis_name: Any,
+    *,
+    site: str,
+    dim: int = 0,
+    chunk_bytes: int = DEFAULT_BUCKET_BYTES,
+):
+    """Tiled ``all_gather`` along ``dim``, issued as independent chunks."""
+    world = static_axis_size(axis_name)
+    dim = dim % x.ndim
+    n = x.shape[dim]
+    row_bytes = (x.size // n) * x.dtype.itemsize
+    slices = bucket_slices(n, row_bytes, chunk_bytes, align=1)
+    if len(slices) == 1:
+        return comms.all_gather(x, axis_name, axis=dim, tiled=True, site=site)
+    parts = []
+    for off, ln in slices:
+        piece = jax.lax.slice_in_dim(x, off, off + ln, axis=dim)
+        g = comms.all_gather(piece, axis_name, axis=dim, tiled=True, site=site)
+        parts.append(
+            g.reshape(g.shape[:dim] + (world, ln) + g.shape[dim + 1:])
+        )
+    cat = jnp.concatenate(parts, axis=dim + 1)
+    return cat.reshape(
+        cat.shape[:dim] + (world * n,) + cat.shape[dim + 2:]
+    )
+
+
+def chunked_reduce_scatter(
+    x,
+    axis_name: Any,
+    *,
+    site: str,
+    dim: int = 0,
+    chunk_bytes: int = DEFAULT_BUCKET_BYTES,
+):
+    """Tiled ``psum_scatter`` along ``dim``, issued as independent chunks."""
+    world = static_axis_size(axis_name)
+    dim = dim % x.ndim
+    total = x.shape[dim]
+    if total % world:
+        raise ValueError(
+            f"scatter dim {dim} (size {total}) not divisible by "
+            f"world={world}"
+        )
+    n = total // world
+    row_bytes = (x.size // total) * x.dtype.itemsize * world
+    slices = bucket_slices(n, row_bytes, chunk_bytes, align=1)
+    if len(slices) == 1:
+        return comms.psum_scatter(
+            x, axis_name, scatter_dimension=dim, tiled=True, site=site
+        )
+    x2 = x.reshape(x.shape[:dim] + (world, n) + x.shape[dim + 1:])
+    parts = []
+    for off, ln in slices:
+        piece = jax.lax.slice_in_dim(x2, off, off + ln, axis=dim + 1)
+        flatp = piece.reshape(
+            piece.shape[:dim] + (world * ln,) + piece.shape[dim + 2:]
+        )
+        parts.append(
+            comms.psum_scatter(
+                flatp, axis_name, scatter_dimension=dim, tiled=True,
+                site=site,
+            )
+        )
+    return jnp.concatenate(parts, axis=dim)
+
+
+# -------------------------------------------------------------- tree grads
+# The DDP path for grads that are still a pytree (not an arena): group leaves
+# into ~bucket_bytes chunks and reduce each group with ONE collective — a
+# variadic psum (single multi-operand AllReduce) when uncompressed, a packed
+# compressed exchange otherwise.
+
+
+def partition_leaves(
+    leaves: Sequence[Any],
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+) -> List[List[int]]:
+    """Greedy dtype-uniform partition of leaf indices into byte-budgeted
+    groups (a leaf larger than the budget gets its own group; order within a
+    dtype is preserved). ``bucket_bytes=None`` -> one group per dtype."""
+    order = sorted(
+        range(len(leaves)),
+        key=lambda i: str(np.dtype(jnp.result_type(leaves[i]))),
+    )
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dt = None
+    for i in order:
+        dt = np.dtype(jnp.result_type(leaves[i]))
+        nb = int(np.prod(jnp.shape(leaves[i]))) * dt.itemsize
+        if cur and (
+            dt != cur_dt
+            or (bucket_bytes is not None and cur_bytes + nb > bucket_bytes)
+        ):
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+        cur_dt = dt
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def bucketed_tree_psum(
+    leaves: Sequence[Any],
+    axis_name: Any,
+    *,
+    site: str,
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    compress: bool = False,
+    wire_dtype: Any = jnp.bfloat16,
+) -> List[Any]:
+    """All-reduce a leaf list group-by-group; returns reduced leaves in the
+    original order/dtypes. Non-float groups always go uncompressed."""
+    out: List[Any] = [None] * len(leaves)
+    for group in partition_leaves(leaves, bucket_bytes):
+        sub = [leaves[i] for i in group]
+        dt = np.dtype(jnp.result_type(sub[0]))
+        # jnp.issubdtype, not np: ml_dtypes (bfloat16) sit outside numpy's
+        # type lattice — a bf16 grad group still wants fp32 accumulation
+        if compress and jnp.issubdtype(dt, jnp.floating):
+            flat = (
+                sub[0].reshape(-1) if len(sub) == 1
+                else jnp.concatenate([x.reshape(-1) for x in sub])
+            )
+            red = _compressed_allreduce(
+                flat, axis_name, site=site, wire_dtype=wire_dtype
+            )
+            off = 0
+            for i, x in zip(group, sub):
+                sz = int(np.prod(jnp.shape(x))) or 1
+                piece = jax.lax.slice_in_dim(red, off, off + sz)
+                out[i] = piece.reshape(jnp.shape(x)).astype(dt)
+                off += sz
+        else:
+            red = comms.psum(tuple(sub), axis_name, site=site)
+            for i, r in zip(group, red):
+                out[i] = r
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketedReduce:
+    """Bundled bucketing policy — the knob object DDP/ZeRO layers carry.
+
+    ``bucket_bytes=None`` disables splitting (monolithic collectives);
+    ``compress=True`` turns on wire-dtype compression with fp32
+    accumulation."""
+
+    axis_name: str = DATA_AXIS
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES
+    compress: bool = False
+    wire_dtype: Any = jnp.bfloat16
+
+    def psum(self, flat, *, site: str = "bucketed.psum"):
+        return bucketed_psum(
+            flat, self.axis_name, site=site, bucket_bytes=self.bucket_bytes,
+            compress=self.compress, wire_dtype=self.wire_dtype,
+        )
+
+    def psum_scatter(self, flat, *, site: str = "bucketed.psum_scatter"):
+        return bucketed_psum_scatter(
+            flat, self.axis_name, site=site, bucket_bytes=self.bucket_bytes,
+            compress=self.compress, wire_dtype=self.wire_dtype,
+        )
+
+    def all_gather(
+        self, shard, *, site: str = "bucketed.all_gather",
+        logical_dtype: Any = None,
+    ):
+        return bucketed_all_gather(
+            shard, self.axis_name, site=site,
+            bucket_bytes=self.bucket_bytes, logical_dtype=logical_dtype,
+        )
+
+    def tree_psum(self, leaves, *, site: str = "bucketed.tree_psum"):
+        return bucketed_tree_psum(
+            leaves, self.axis_name, site=site,
+            bucket_bytes=self.bucket_bytes, compress=self.compress,
+            wire_dtype=self.wire_dtype,
+        )
+
+    def n_buckets(self, n_elements: int, itemsize: int) -> int:
+        return n_buckets(n_elements, itemsize, self.bucket_bytes)

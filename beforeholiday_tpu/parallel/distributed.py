@@ -26,6 +26,8 @@ import jax.numpy as jnp
 
 from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.monitor.spans import span
+from beforeholiday_tpu.ops.arena import PackedParams
+from beforeholiday_tpu.parallel import bucketing
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
 
 
@@ -59,6 +61,9 @@ def reduce_gradients(
     gradient_predivide_factor: Optional[float] = None,
     allreduce_always_fp32: bool = False,
     check_consistency: bool = False,
+    bucket_bytes: Optional[int] = None,
+    compress: bool = False,
+    wire_dtype: Any = jnp.bfloat16,
 ) -> Any:
     """psum a gradient pytree over ``axis_name`` with apex's scaling options.
 
@@ -81,6 +86,19 @@ def reduce_gradients(
     raises. NOTE: only meaningful when every rank is expected to hold the SAME
     grads pre-reduce (replicated-batch debugging / overfit checks), not for
     ordinary data-parallel steps where per-rank grads legitimately differ.
+
+    ``bucket_bytes`` switches to the bucketed path (``parallel.bucketing``):
+    grads go out as independent ~bucket_bytes collectives the latency-hiding
+    scheduler can overlap with remaining backward compute — the XLA-era
+    analogue of the reference's backward-hook buckets
+    (apex/parallel/distributed.py:352-409). ``PackedParams`` grads (arena
+    native) bucket their flat arenas directly; tree grads are grouped
+    greedily per dtype and each group is ONE variadic psum. Uncompressed
+    bucketing is bitwise-identical to the default path. ``compress=True``
+    additionally puts ``wire_dtype`` (default bf16) on the wire with fp32
+    accumulation — see ``bucketing.compression_error_bound`` for the analytic
+    error bound. Default (``bucket_bytes=None, compress=False``) is the
+    legacy per-leaf psum, unchanged.
     """
     with span("ddp_reduce_gradients"):
         world = _axis_size(axis_name)
@@ -103,13 +121,14 @@ def reduce_gradients(
                 > 0
             )
 
-        def _reduce(g):
-            orig_dtype = g.dtype
+        def _pre(g):
             if allreduce_always_fp32:
                 g = g.astype(jnp.float32)
             if gradient_predivide_factor is not None:
                 g = g / gradient_predivide_factor
-            g = comms.psum(g, axis_name, site="ddp.reduce_gradients")
+            return g
+
+        def _post(g, orig_dtype):
             if gradient_average:
                 if gradient_predivide_factor is not None:
                     g = g / (world / gradient_predivide_factor)
@@ -119,7 +138,41 @@ def reduce_gradients(
                 g = g.astype(orig_dtype)
             return g
 
-        reduced = jax.tree.map(_reduce, grads)
+        bucketed = bucket_bytes is not None or compress
+        if not bucketed:
+
+            def _reduce(g):
+                return _post(
+                    comms.psum(
+                        _pre(g), axis_name, site="ddp.reduce_gradients"
+                    ),
+                    g.dtype,
+                )
+
+            reduced = jax.tree.map(_reduce, grads)
+        elif isinstance(grads, PackedParams):
+            # arena-native grads: bucket each flat arena directly
+            arenas = [
+                _post(
+                    bucketing.bucketed_psum(
+                        _pre(a), axis_name, site="ddp.bucketed_reduce",
+                        bucket_bytes=bucket_bytes, compress=compress,
+                        wire_dtype=wire_dtype,
+                    ),
+                    a.dtype,
+                )
+                for a in grads.arenas
+            ]
+            reduced = grads.replace_arenas(arenas)
+        else:
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            red = bucketing.bucketed_tree_psum(
+                [_pre(g) for g in leaves], axis_name,
+                site="ddp.bucketed_reduce", bucket_bytes=bucket_bytes,
+                compress=compress, wire_dtype=wire_dtype,
+            )
+            red = [_post(r, g.dtype) for r, g in zip(red, leaves)]
+            reduced = jax.tree_util.tree_unflatten(treedef, red)
         if check_consistency:
             return reduced, mismatch
         return reduced
@@ -133,8 +186,18 @@ class Reducer:
     pytree operations usable inside shard_map.
     """
 
-    def __init__(self, axis_name: str = DATA_AXIS):
+    def __init__(
+        self,
+        axis_name: str = DATA_AXIS,
+        *,
+        bucket_bytes: Optional[int] = None,
+        compress: bool = False,
+        wire_dtype: Any = jnp.bfloat16,
+    ):
         self.axis_name = axis_name
+        self.bucket_bytes = bucket_bytes
+        self.compress = compress
+        self.wire_dtype = wire_dtype
 
     def broadcast_params(self, params: Any) -> Any:
         """Make params exactly rank 0's values on every rank (ref:
@@ -155,7 +218,9 @@ class Reducer:
 
     def reduce(self, tree: Any, average: bool = True) -> Any:
         return reduce_gradients(
-            tree, axis_name=self.axis_name, gradient_average=average
+            tree, axis_name=self.axis_name, gradient_average=average,
+            bucket_bytes=self.bucket_bytes, compress=self.compress,
+            wire_dtype=self.wire_dtype,
         )
 
 
@@ -180,11 +245,17 @@ class DistributedDataParallel:
         gradient_average: bool = True,
         gradient_predivide_factor: Optional[float] = None,
         allreduce_always_fp32: bool = False,
+        bucket_bytes: Optional[int] = None,
+        compress: bool = False,
+        wire_dtype: Any = jnp.bfloat16,
     ):
         self.axis_name = axis_name
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
+        self.bucket_bytes = bucket_bytes
+        self.compress = compress
+        self.wire_dtype = wire_dtype
 
     def reduce(self, grads: Any) -> Any:
         return reduce_gradients(
@@ -193,6 +264,9 @@ class DistributedDataParallel:
             gradient_average=self.gradient_average,
             gradient_predivide_factor=self.gradient_predivide_factor,
             allreduce_always_fp32=self.allreduce_always_fp32,
+            bucket_bytes=self.bucket_bytes,
+            compress=self.compress,
+            wire_dtype=self.wire_dtype,
         )
 
     def value_and_grad(

@@ -23,12 +23,38 @@ import jax
 import jax.numpy as jnp
 
 from beforeholiday_tpu.monitor import comms
+from beforeholiday_tpu.parallel import bucketing
 from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
+
+# Optional chunking of the TP/SP gathers and reduce-scatters: when set, any
+# mapping whose payload exceeds the budget is issued as independent
+# ~chunk_bytes collectives (``parallel.bucketing``, bitwise-equal to the
+# monolithic op) so XLA can overlap them with the adjacent matmuls. Off by
+# default — small activations gain nothing and the single-collective layouts
+# stay byte-identical for the ledger oracles.
+_CHUNK_BYTES = None
+
+
+def set_collective_chunk_bytes(n):
+    """Set the TP/SP collective chunk budget (bytes); ``None`` disables.
+    Returns the previous value so callers can restore it."""
+    global _CHUNK_BYTES
+    prev = _CHUNK_BYTES
+    if n is not None:
+        n = int(n)
+        if n <= 0:
+            raise ValueError(f"chunk_bytes must be positive, got {n}")
+    _CHUNK_BYTES = n
+    return prev
+
+
+def collective_chunk_bytes():
+    return _CHUNK_BYTES
 
 
 def _split_along(x, dim, axis_name):
     """This rank's shard of x along dim (ref: mappings.py _split last-dim split)."""
-    world = jax.lax.axis_size(axis_name)
+    world = bucketing.static_axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     size = x.shape[dim]
     assert size % world == 0, f"dim {dim} size {size} not divisible by {world}"
@@ -37,10 +63,18 @@ def _split_along(x, dim, axis_name):
 
 
 def _all_gather(x, dim, axis_name, *, site):
+    if _CHUNK_BYTES is not None:
+        return bucketing.chunked_all_gather(
+            x, axis_name, site=site, dim=dim, chunk_bytes=_CHUNK_BYTES
+        )
     return comms.all_gather(x, axis_name, site=site, axis=dim, tiled=True)
 
 
 def _reduce_scatter(x, dim, axis_name, *, site):
+    if _CHUNK_BYTES is not None:
+        return bucketing.chunked_reduce_scatter(
+            x, axis_name, site=site, dim=dim, chunk_bytes=_CHUNK_BYTES
+        )
     return comms.psum_scatter(
         x, axis_name, site=site, scatter_dimension=dim, tiled=True
     )

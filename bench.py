@@ -475,8 +475,10 @@ def make_fused_adam_rungs():
     Rungs (every one a gen-refreshed fori_loop chain; gen loops timed and
     subtracted so the ratios compare optimizer work only):
 
-    * ``dropin``:  FusedAdam.step_flat with the grad TREE flattened inside the
-      step — what a tree-based training loop pays — vs tree optax.adamw.
+    * ``dropin``:  FusedAdam.step_flat fed the grad LEAF LIST — the view path
+      (per-leaf update against arena views, outputs reassembled by one concat
+      pass; no materialized grad arena) — what a tree-based training loop
+      pays — vs tree optax.adamw.
     * ``kernel``:  step_flat on pre-flattened grads — the arena-NATIVE cost
       (grads born flat via PackedParams; see fused_adam_kernel_ms).
     * ``o5``:      the shipped amp O5 packed master-weight step
@@ -502,12 +504,12 @@ def make_fused_adam_rungs():
     fstate = fused.init_flat(pf)
     ost = opt.init(params)
 
-    # --- fp32 drop-in (flatten inside) vs tree optax ---
+    # --- fp32 drop-in (leaf-list view path, no in-step arena pack) vs tree
+    # optax ---
     def dropin_step(s):
         p, st, g = s
         g = _gen_tree(g)
-        gflat, _ = flatten(list(g.values()))
-        p, st = fused.step_flat(p, gflat, st)
+        p, st = fused.step_flat(p, list(g.values()), st)
         return (p, st, g)
 
     def optax_step(s):
@@ -593,9 +595,10 @@ def measure_fused_adam(chains, pairs=3):
         # r04's "fused_adam_kernel_*"
         "fused_adam_native_ms": _med_sub(t, "kernel", "gen_flat") * 1e3,
         "fused_adam_native_vs_optax": _sub_ratio(t, "optax", "kernel", "gen_tree", "gen_flat"),
-        # legacy tree-grads step_flat interface (flattens in-step) — r04's
-        # "fused_adam_46M_ms"/"fused_adam_vs_optax"; loses by design, the
-        # concat pack costs ~2 ms at 46M — that is WHY arena_native exists
+        # tree-grads step_flat interface, now the VIEW path (per-leaf updates
+        # into arena views, one concat write-back) — r04's
+        # "fused_adam_46M_ms"/"fused_adam_vs_optax"; r05 measured the old
+        # in-step concat pack at 0.54x optax, which the view path removes
         "fused_adam_treeapi_ms": _med_sub(t, "dropin", "gen_tree") * 1e3,
         "fused_adam_treeapi_vs_optax": _sub_ratio(t, "optax", "dropin", "gen_tree", "gen_tree"),
         # shipped amp O5 packed master-weights step vs the optax O5 chain;
@@ -835,6 +838,31 @@ def bench_pp_overhead():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_comms_overhead():
+    """Bucketed-collective overhead on the same virtual 8-CPU mesh subprocess
+    as ``bench_pp_overhead`` — a DISPATCH-COST PROXY, not a TPU number (the
+    CPU 'wire' is memcpy, so bucketing/compression wins from overlap and
+    halved ICI bytes are invisible; what this catches is the bucketing layer
+    itself getting expensive). Same env scrub as pp_bench."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.comms_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"comms_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------------
@@ -955,14 +983,21 @@ def main():
         for k, val in r1.items():
             detail[k] = round(val, 3)
         detail["fused_adam_n_params"] = n_params
+        # the r05 regression gate: the tree-grads interface must at least
+        # match optax now that it takes the view path instead of packing an
+        # arena per step
+        detail["fused_adam_treeapi_ok"] = (
+            r1["fused_adam_treeapi_vs_optax"] >= 1.0
+        )
         pass2.update(r2)
         detail["fused_adam_note"] = (
             "gen-subtracted fori_loop meter; native = shipped arena_native "
             "path (grads born flat, maps to r04 fused_adam_kernel_*); "
-            "treeapi = legacy tree-grads interface incl. in-step pack (maps "
-            "to r04 fused_adam_vs_optax); single-buffer streaming caps at "
-            "~670 GB/s on this chip (7-pass floor 1.95 ms), multi-buffer "
-            "concurrency takes the fused step below it"
+            "treeapi = tree-grads interface on the VIEW path (per-leaf "
+            "updates into arena views, no in-step pack — fixes r05's 0.54x); "
+            "single-buffer streaming caps at ~670 GB/s on this chip (7-pass "
+            "floor 1.95 ms), multi-buffer concurrency takes the fused step "
+            "below it"
         )
         chains = None
     adam = None
@@ -1043,6 +1078,19 @@ def main():
             if k in pp_res:
                 detail[f"pp_{k}"] = pp_res[k]
         detail["pp_note"] = "schedule-logic proxy on an 8-CPU mesh, not a TPU number"
+
+    # --- bucketed collectives (CPU proxy, subprocess) ---
+    comms_res = _stage(detail, bench_comms_overhead)
+    if comms_res:
+        for k in ("ddp_bucketed_vs_monolithic", "zero2_compressed_vs_fp32"):
+            detail[k] = comms_res[k]
+        detail["comms_bucket_bytes"] = comms_res["bucket_bytes"]
+        detail["comms_n_buckets"] = comms_res["n_buckets"]
+        detail["comms_note"] = (
+            "dispatch-cost proxy on an 8-CPU mesh: bucketed reduce is "
+            "bitwise-checked vs monolithic in-process; overlap and wire-byte "
+            "wins need real ICI"
+        )
 
     # --- guard dispatch + comms + compile counters: what every rung above
     # actually dispatched/communicated/compiled (collected LAST so the

@@ -146,6 +146,22 @@ def test_monitor_package_is_scanned():
     assert set(_SANCTIONED_BY_FILE) == {"monitor/export.py", "monitor/trace.py"}
     assert _SANCTIONED_BY_FILE["monitor/export.py"] == {"drain", "flush", "_fetch"}
     assert _SANCTIONED_BY_FILE["monitor/trace.py"] == {"export"}
+
+
+def test_bucketing_is_scanned():
+    """parallel/bucketing.py promises static bucket geometry with no host
+    readbacks (its docstring cites this scan) — pin that the scanner actually
+    reaches it with no waivers or file-scoped sanctions."""
+    parallel_files = sorted(
+        p.relative_to(_PKG_ROOT).as_posix()
+        for p in (_PKG_ROOT / "parallel").rglob("*.py")
+    )
+    assert "parallel/bucketing.py" in parallel_files
+    assert "parallel" not in _SKIP_DIRS
+    assert not any(path.startswith("parallel/") for path in _SANCTIONED_BY_FILE)
+    assert not any(
+        path.startswith("parallel/") for path, _ in _WAIVED
+    )
     # and no monitor file carries a (file, func) waiver — the sanction list
     # above is the entire exception surface for the subsystem
     assert not [k for k in _WAIVED if k[0].startswith("monitor/")]
