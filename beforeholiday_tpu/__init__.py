@@ -27,7 +27,10 @@ capability surface of NVIDIA Apex (reference: /root/reference):
   skip/rollback state machine generalizing the loss scaler.
 - ``beforeholiday_tpu.monitor``     — jit-safe observability: device-side metrics
   pytree with psum cross-rank aggregation, single-readback MetricsLogger export,
-  trace spans/timers, and guard-dispatch counters.
+  trace spans/timers, guard-dispatch counters, and the per-jit memory ledger.
+- ``beforeholiday_tpu.remat``       — activation-memory engine: named remat policies
+  (``jax.checkpoint`` + boundary tags, ref: apex/transformer checkpointed layers)
+  and buffer-donation helpers for step functions.
 
 Unlike the reference, which grafts CUDA kernels onto PyTorch via monkey-patching,
 this framework is functional and mesh-first: precision policies are dtype policies
@@ -42,6 +45,7 @@ from beforeholiday_tpu import monitor
 from beforeholiday_tpu import ops
 from beforeholiday_tpu import optimizers
 from beforeholiday_tpu import parallel
+from beforeholiday_tpu import remat
 from beforeholiday_tpu import rnn
 from beforeholiday_tpu import transformer
 from beforeholiday_tpu.utils.logging import get_logger
@@ -56,6 +60,7 @@ __all__ = [
     "ops",
     "optimizers",
     "parallel",
+    "remat",
     "rnn",
     "transformer",
     "get_logger",

@@ -19,6 +19,8 @@ Split by which side of the device boundary each piece lives on:
   Chrome-trace/Perfetto ``trace.json`` exporter (``timeline``).
 * :mod:`beforeholiday_tpu.monitor.compile`  — recompile sentinel
   (``track_compiles``: count signatures per jitted entry, warn on storms).
+* :mod:`beforeholiday_tpu.monitor.memory`   — per-jit memory ledger
+  (``track_memory``: AOT ``memory_analysis()`` bytes per entry/signature).
 """
 
 # NOTE on the name ``trace``: importing the ``monitor.trace`` SUBMODULE below
@@ -64,6 +66,13 @@ from beforeholiday_tpu.monitor.compile import (  # noqa: F401
     reset_compile_counts,
     track_compiles,
 )
+from beforeholiday_tpu.monitor.memory import (  # noqa: F401
+    measure_memory,
+    memory_records,
+    memory_summary,
+    reset_memory_ledger,
+    track_memory,
+)
 
 __all__ = [
     "Metrics",
@@ -81,14 +90,19 @@ __all__ = [
     "dispatch_summary",
     "global_norm",
     "ledger_scope",
+    "measure_memory",
+    "memory_records",
+    "memory_summary",
     "nvtx_range",
     "reset_comms_ledger",
     "reset_compile_counts",
     "reset_dispatch_counters",
+    "reset_memory_ledger",
     "span",
     "start_trace",
     "stop_trace",
     "timeline",
     "trace",
     "track_compiles",
+    "track_memory",
 ]

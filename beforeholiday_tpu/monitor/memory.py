@@ -1,0 +1,203 @@
+"""Per-jit memory ledger — AOT ``memory_analysis()`` per tracked entry point.
+
+The recompile sentinel (``monitor/compile.py``) answers "how many executables
+does this entry own"; this ledger answers the next question a TPU run hits:
+"how much HBM does each executable need". XLA already knows — every compiled
+executable carries a ``CompiledMemoryStats`` (temp/argument/output/alias
+bytes) — but the numbers are only reachable through the AOT API
+(``fn.lower(...).compile().memory_analysis()``), so by default nobody looks
+until the first OOM.
+
+``track_memory`` closes that gap with the same registry pattern as
+``track_compiles``: wrap ABOVE ``jax.jit``, and on each NEW abstract
+signature the entry is compiled once through the AOT path, its memory stats
+recorded, and the compiled executable cached and reused for every subsequent
+call with that signature — one compilation total, stats as a side effect.
+``temp_bytes`` is the number remat exists to shrink: the scratch the
+executable allocates beyond its inputs/outputs, i.e. saved activations.
+
+Usage::
+
+    @monitor.track_memory("train_step")
+    @jax.jit
+    def train_step(params, batch): ...
+
+    monitor.memory_summary()
+    # [{"entry": "train_step", "calls": 400, "signatures": 1,
+    #   "peak_temp_bytes": 123456, "argument_bytes": ..., ...}]
+
+Host-only and jit-safe: signatures are shapes/treedefs, stats come from the
+compiler, no device value is ever read back. New records are mirrored to the
+active Perfetto trace recorder as instant events (``memory:<entry>``) so the
+timeline shows memory next to the spans it belongs to. State is
+process-global; ``reset_memory_ledger()`` clears it between configurations.
+
+Caveat: tracked functions must take array (or array-pytree) arguments —
+the cached AOT executable is called directly, which bypasses ``jax.jit``'s
+python-scalar weak-type handling and static-argument re-binding.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from beforeholiday_tpu.monitor.compile import _sig_of
+
+__all__ = [
+    "measure_memory",
+    "memory_records",
+    "memory_summary",
+    "reset_memory_ledger",
+    "track_memory",
+]
+
+_LOCK = threading.Lock()
+# entry -> {"signatures": {sig: {"stats": dict|None, "compiled": obj|None,
+#                                "first_call": int}},
+#           "calls": int}
+_ENTRIES: Dict[str, Dict[str, Any]] = {}
+
+_STAT_FIELDS = (
+    ("temp_bytes", "temp_size_in_bytes"),
+    ("argument_bytes", "argument_size_in_bytes"),
+    ("output_bytes", "output_size_in_bytes"),
+    ("alias_bytes", "alias_size_in_bytes"),
+    ("generated_code_bytes", "generated_code_size_in_bytes"),
+)
+
+
+def _stats_of(analysis: Any) -> Optional[Dict[str, int]]:
+    """``CompiledMemoryStats`` -> plain dict (None when the backend offers
+    no analysis)."""
+    if analysis is None:
+        return None
+    out = {}
+    for key, attr in _STAT_FIELDS:
+        val = getattr(analysis, attr, None)
+        out[key] = int(val) if val is not None else 0
+    return out
+
+
+def _aot_compile(fn: Callable, args, kwargs):
+    """(compiled, stats) via the AOT path; (None, None) when unavailable."""
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        return None, None
+    try:
+        compiled = lower(*args, **kwargs).compile()
+        return compiled, _stats_of(compiled.memory_analysis())
+    except Exception:  # noqa: BLE001 — backend without AOT/memory support
+        return None, None
+
+
+def _mirror_to_trace(entry: str, stats: Optional[Dict[str, int]]) -> None:
+    """Emit the new record as an instant event on the active Perfetto
+    recorder (host dicts only — no device work)."""
+    if stats is None:
+        return
+    from beforeholiday_tpu.monitor.trace import active_recorder
+
+    rec = active_recorder()
+    if rec is not None:
+        rec.instant(f"memory:{entry}", args=dict(stats))
+
+
+def track_memory(entry: str):
+    """Decorator: record ``memory_analysis()`` stats per abstract signature.
+
+    Apply OUTSIDE ``jax.jit``. Each new signature compiles ONCE through the
+    AOT path (the executable is cached and every call routed through it, so
+    tracking never double-compiles); repeat signatures dispatch straight to
+    the cached executable."""
+
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            sig = _sig_of(args, kwargs)
+            with _LOCK:
+                row = _ENTRIES.setdefault(entry, {"signatures": {}, "calls": 0})
+                row["calls"] += 1
+                rec = row["signatures"].get(sig)
+                calls = row["calls"]
+            if rec is None:
+                compiled, stats = _aot_compile(fn, args, kwargs)
+                with _LOCK:
+                    row = _ENTRIES.setdefault(
+                        entry, {"signatures": {}, "calls": calls}
+                    )
+                    rec = row["signatures"].setdefault(
+                        sig,
+                        {"stats": stats, "compiled": compiled,
+                         "first_call": calls},
+                    )
+                _mirror_to_trace(entry, rec["stats"])
+            compiled = rec["compiled"]
+            if compiled is not None:
+                return compiled(*args, **kwargs)
+            return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapper")
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return deco
+
+
+def measure_memory(fn: Callable, *args, entry: Optional[str] = None, **kwargs):
+    """One-off AOT measurement: compile ``fn`` for these arguments and return
+    its stats dict (None if the backend offers no analysis). When ``entry``
+    is given the measurement is also recorded in the ledger (calls stay 0 —
+    the function is compiled, not executed)."""
+    compiled, stats = _aot_compile(fn, args, kwargs)
+    del compiled
+    if entry is not None:
+        sig = _sig_of(args, kwargs)
+        with _LOCK:
+            row = _ENTRIES.setdefault(entry, {"signatures": {}, "calls": 0})
+            row["signatures"].setdefault(
+                sig, {"stats": stats, "compiled": None, "first_call": 0}
+            )
+        _mirror_to_trace(entry, stats)
+    return stats
+
+
+def memory_records() -> Dict[str, Dict[str, Any]]:
+    """Raw ledger: ``{entry: {"calls": n, "signatures": [stats, ...]}}`` —
+    one stats dict (or None) per distinct abstract signature."""
+    with _LOCK:
+        return {
+            name: {
+                "calls": row["calls"],
+                "signatures": [
+                    dict(r["stats"]) if r["stats"] is not None else None
+                    for r in row["signatures"].values()
+                ],
+            }
+            for name, row in _ENTRIES.items()
+        }
+
+
+def memory_summary() -> List[Dict[str, object]]:
+    """``compile_summary``-style rollup: one sorted row per entry with the
+    max over its signatures for every byte counter (``peak_temp_bytes`` is
+    the headline — saved-activation scratch)."""
+    rows = []
+    for name, row in sorted(memory_records().items()):
+        stats = [s for s in row["signatures"] if s is not None]
+        rollup = {
+            "entry": name,
+            "calls": row["calls"],
+            "signatures": len(row["signatures"]),
+            "peak_temp_bytes": max((s["temp_bytes"] for s in stats), default=0),
+        }
+        for key, _ in _STAT_FIELDS[1:]:
+            rollup[key] = max((s[key] for s in stats), default=0)
+        rows.append(rollup)
+    return rows
+
+
+def reset_memory_ledger() -> None:
+    """Forget all entries (and drop their cached executables). Tracked
+    functions recompile through the AOT path on their next call."""
+    with _LOCK:
+        _ENTRIES.clear()

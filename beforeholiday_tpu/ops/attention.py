@@ -35,10 +35,15 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from beforeholiday_tpu.guard.dispatch import checked_impl as _checked_impl
+from beforeholiday_tpu.remat.policies import (
+    TAG_ATTN_OUT as _TAG_ATTN_OUT,
+    TAG_FLASH_LSE as _TAG_FLASH_LSE,
+)
 from beforeholiday_tpu.ops._autocast import autocast_dtype
 from beforeholiday_tpu.ops._pallas_util import (
     interpret_default as _interpret_default,
@@ -432,6 +437,10 @@ def _flash3(q, k, v, lens, seed, causal, scale, rate):
 def _flash3_fwd(q, k, v, lens, seed, causal, scale, rate):
     o, lse = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default(),
                             rate, seed)
+    # remat boundary tag: under a save_only_these_names policy the (BH, S)
+    # lse rows survive checkpointing so the flash backward can rebuild the
+    # probabilities without a full forward re-run (identity otherwise)
+    lse = _checkpoint_name(lse, _TAG_FLASH_LSE)
     return o, (q, k, v, lens, seed, o, lse)
 
 
@@ -458,6 +467,7 @@ def _flash3_lse(q, k, v, lens, causal, scale):
 
 def _flash3_lse_fwd(q, k, v, lens, causal, scale):
     o, lse = _fa_fwd_pallas(q, k, v, lens, causal, scale, _interpret_default())
+    lse = _checkpoint_name(lse, _TAG_FLASH_LSE)
     return (o, lse[..., 0]), (q, k, v, lens, o, lse)
 
 
@@ -645,7 +655,9 @@ def flash_attention(
         else:
             o = _attn_jnp(q3, k3, v3, lens_bh, causal, scale,
                           dropout_rate, dropout_key)
-    return o.reshape(B, H, S, D)
+    # remat boundary tag: the attention context is a cheap (B, H, S, D)
+    # save point vs the O(S^2) score/prob intermediates behind it
+    return _checkpoint_name(o.reshape(B, H, S, D), _TAG_ATTN_OUT)
 
 
 def self_attention(

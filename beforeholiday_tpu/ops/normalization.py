@@ -26,10 +26,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from beforeholiday_tpu.guard.dispatch import checked_impl as _checked_impl
+from beforeholiday_tpu.remat.policies import TAG_NORM_OUT as _TAG_NORM_OUT
 from beforeholiday_tpu.ops._autocast import float_function
 from beforeholiday_tpu.ops._pallas_util import (
     interpret_default as _interpret_default,
@@ -287,4 +289,6 @@ def _norm_impl(x, weight, bias, eps, rms, out_dtype, impl):
             eps=float(eps), rms=rms, out_dtype=jnp.dtype(out_dtype),
         )
     y = _layer_norm(x2d, weight, bias, float(eps), rms, jnp.dtype(out_dtype), impl)
-    return y.reshape(x.shape)
+    # remat boundary tag: a saved norm output lets the matmul that consumes
+    # it skip re-running the norm in backward (identity outside checkpoint)
+    return _checkpoint_name(y.reshape(x.shape), _TAG_NORM_OUT)

@@ -1,0 +1,30 @@
+"""Activation-memory engine: remat policies + buffer donation.
+
+``remat.apply(fn, "save_boundaries")`` wraps a scan body or pipeline stage
+with a named rematerialization policy (see ``policies``); ``donate_step``
+wires ``donate_argnums`` into a training step (see ``donation``). The
+per-jit memory ledger that measures the effect lives in
+``beforeholiday_tpu.monitor.memory``.
+"""
+
+from beforeholiday_tpu.remat import donation, policies
+from beforeholiday_tpu.remat.donation import donate_optimizer_step, donate_step
+from beforeholiday_tpu.remat.policies import (
+    BOUNDARY_TAGS,
+    apply,
+    available_policies,
+    register_policy,
+    resolve,
+)
+
+__all__ = [
+    "BOUNDARY_TAGS",
+    "apply",
+    "available_policies",
+    "donate_optimizer_step",
+    "donate_step",
+    "donation",
+    "policies",
+    "register_policy",
+    "resolve",
+]

@@ -28,9 +28,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS, TENSOR_AXIS
+from beforeholiday_tpu.remat import apply as _remat_apply
+from beforeholiday_tpu.remat.policies import TAG_BLOCK as _TAG_BLOCK
 from beforeholiday_tpu.testing._model_utils import (
     vocab_head_matmul as _vocab_head_matmul,
     constrain as _constrain,
@@ -57,6 +60,9 @@ class BertConfig:
     # receives a dropout_key
     dropout_rate: float = 0.0
     attention_dropout: float = 0.0
+    # activation rematerialization over the encoder stack: a registered
+    # beforeholiday_tpu.remat policy name; None = no remat
+    remat_policy: Optional[str] = None
 
     @property
     def ff(self) -> int:
@@ -211,7 +217,8 @@ def _block(cfg: BertConfig, x, lens, lp, dkey=None):
         fused_dense(h, lp["wo2"].astype(x.dtype), lp["bo2"].astype(x.dtype)), 2
     )
     x = _layernorm(x + mlp_out, lp["ln2_scale"], lp["ln2_bias"]).astype(x.dtype)
-    return _constrain(x, _residual_spec(cfg))
+    # remat boundary tag: one (B, S, D) residual per layer (see testing/gpt.py)
+    return _checkpoint_name(_constrain(x, _residual_spec(cfg)), _TAG_BLOCK)
 
 
 def forward(params: dict, tokens: jax.Array, cfg: BertConfig,
@@ -235,17 +242,28 @@ def forward(params: dict, tokens: jax.Array, cfg: BertConfig,
         x = dropout(jax.random.fold_in(dropout_key, 0x7FFFFFFF), x, cfg.dropout_rate)
     x = _constrain(x, _residual_spec(cfg))
 
+    # cfg.remat_policy wraps the scanned encoder block (lens passed as an
+    # explicit arg so the checkpointed fn closes over no traced values)
     if dropout_key is not None:
         layer_keys = jax.random.split(dropout_key, cfg.n_layers)
+        blk = _remat_apply(
+            lambda carry, lens_, lp, lk: _block(cfg, carry, lens_, lp, dkey=lk),
+            cfg.remat_policy,
+        )
 
         def body(carry, xs):
             lp, lk = xs
-            return _block(cfg, carry, lens, lp, dkey=lk), None
+            return blk(carry, lens, lp, lk), None
 
         x, _ = jax.lax.scan(body, x, (params["blocks"], layer_keys))
     else:
+        blk = _remat_apply(
+            lambda carry, lens_, lp: _block(cfg, carry, lens_, lp),
+            cfg.remat_policy,
+        )
+
         def body(carry, lp):
-            return _block(cfg, carry, lens, lp), None
+            return blk(carry, lens, lp), None
 
         x, _ = jax.lax.scan(body, x, params["blocks"])
 

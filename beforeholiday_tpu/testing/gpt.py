@@ -25,9 +25,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS, TENSOR_AXIS
+from beforeholiday_tpu.remat import apply as _remat_apply
+from beforeholiday_tpu.remat.policies import TAG_BLOCK as _TAG_BLOCK
 from beforeholiday_tpu.testing._model_utils import (
     vocab_head_matmul as _vocab_head_matmul,
     constrain as _constrain,
@@ -56,6 +59,10 @@ class GPTConfig:
     # when forward() receives a dropout_key
     dropout_rate: float = 0.0          # embedding + post-attn + post-MLP
     attention_dropout: float = 0.0     # softmax-probs dropout (jnp attn path)
+    # activation rematerialization over the scanned block: a registered
+    # beforeholiday_tpu.remat policy name ("none"/"full"/"dots_saveable"/
+    # "save_boundaries"); None = no remat
+    remat_policy: Optional[str] = None
 
     @property
     def ff(self) -> int:
@@ -180,7 +187,9 @@ def _block(cfg: GPTConfig, x, lp, dkey=None):
     h = jax.nn.gelu(fused_dense(h, lp["wi"].astype(h.dtype), lp["bi"].astype(h.dtype)))
     mlp_out = fused_dense(h, lp["wo2"].astype(x.dtype), lp["bo2"].astype(x.dtype))
     x = x + drop(mlp_out, 2, cfg.dropout_rate)
-    return _constrain(x, _residual_spec(cfg))
+    # remat boundary tag: the residual stream between blocks is the cheapest
+    # possible save point — one (B, S, D) tensor per layer
+    return _checkpoint_name(_constrain(x, _residual_spec(cfg)), _TAG_BLOCK)
 
 
 def forward(params: dict, tokens: jax.Array, cfg: GPTConfig,
@@ -196,17 +205,28 @@ def forward(params: dict, tokens: jax.Array, cfg: GPTConfig,
         x = dropout(jax.random.fold_in(dropout_key, 0x7FFFFFFF), x, cfg.dropout_rate)
     x = _constrain(x, _residual_spec(cfg))
 
+    # cfg.remat_policy wraps the scanned block body: with scan-over-layers the
+    # saved-residual stack is L x (per-block residuals), so the block is
+    # exactly the granularity Chen/Megatron checkpointing wants
     if dropout_key is not None:
         layer_keys = jax.random.split(dropout_key, cfg.n_layers)
+        blk = _remat_apply(
+            lambda carry, lp, lk: _block(cfg, carry, lp, dkey=lk),
+            cfg.remat_policy,
+        )
 
         def body(carry, xs):
             lp, lk = xs
-            return _block(cfg, carry, lp, dkey=lk), None
+            return blk(carry, lp, lk), None
 
         x, _ = jax.lax.scan(body, x, (params["blocks"], layer_keys))
     else:
+        blk = _remat_apply(
+            lambda carry, lp: _block(cfg, carry, lp), cfg.remat_policy
+        )
+
         def body(carry, lp):
-            return _block(cfg, carry, lp), None
+            return blk(carry, lp), None
 
         x, _ = jax.lax.scan(body, x, params["blocks"])
     x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.monitor.spans import span
 from beforeholiday_tpu.parallel.parallel_state import PIPE_AXIS
+from beforeholiday_tpu.remat import apply as _remat_apply
 from beforeholiday_tpu.transformer.pipeline_parallel import p2p_communication
 
 
@@ -74,11 +75,15 @@ def forward_backward_no_pipelining(
     params: Any,
     inputs: jax.Array,
     targets: jax.Array,
+    remat_policy: Optional[str] = None,
     **_,
 ):
     """Grad-accumulation loop without stage parallelism
     (ref: schedules/fwd_bwd_no_pipelining.py). inputs/targets lead with the
-    microbatch dim (M, ...). Returns (mean loss, param grads)."""
+    microbatch dim (M, ...). Returns (mean loss, param grads).
+    ``remat_policy``: named ``beforeholiday_tpu.remat`` policy applied to the
+    model function (None = save everything)."""
+    stage_fn = _remat_apply(stage_fn, remat_policy)
     M = inputs.shape[0]
 
     def mb_loss(params, x, tgt):
@@ -480,6 +485,7 @@ def forward_backward_pipelining_without_interleaving(
     embed_params: Any = None,
     head_fn: Optional[Callable] = None,
     head_params: Any = None,
+    remat_policy: Optional[str] = None,
 ):
     """1F1B schedule (ref: fwd_bwd_pipelining_without_interleaving.py:228-488).
 
@@ -490,7 +496,14 @@ def forward_backward_pipelining_without_interleaving(
     pytree when no embed/head is given (backward compatible), else a
     ``PipelineGrads(stage, embed, head)``. Loss is valid on every stage
     (psum'd), as the reference broadcasts it.
+
+    ``remat_policy``: named ``beforeholiday_tpu.remat`` policy applied to the
+    per-stage function — per-stage remat is where 1F1B earns its memory back:
+    the warmup phase holds up to S in-flight microbatches of stage residuals,
+    and checkpointing the stage shrinks each held set to its boundary saves
+    (ref: apex/transformer checkpointed layers).
     """
+    stage_fn = _remat_apply(stage_fn, remat_policy)
     chunked = jax.tree.map(lambda leaf: leaf[None], params)
     loss, g_stage, g_embed, g_head = _pipelined_fwd_bwd(
         stage_fn, loss_fn, chunked, inputs, targets, V=1, axis_name=axis_name,
@@ -528,6 +541,7 @@ def forward_backward_pipelining_encoder_decoder(
     dec_embed_params: Any = None,
     head_fn: Optional[Callable] = None,
     head_params: Any = None,
+    remat_policy: Optional[str] = None,
 ):
     """T5-style encoder-and-decoder 1F1B schedule
     (ref: apex/transformer/pipeline_parallel/schedules/common.py:83,312 —
@@ -560,6 +574,7 @@ def forward_backward_pipelining_encoder_decoder(
 
     Returns ``(mean loss, EncDecPipelineGrads)``.
     """
+    stage_fn = _remat_apply(stage_fn, remat_policy)
     if split_rank is None:
         from beforeholiday_tpu.parallel.parallel_state import (
             get_pipeline_model_parallel_split_rank,
@@ -813,6 +828,7 @@ def forward_backward_pipelining_with_interleaving(
     embed_params: Any = None,
     head_fn: Optional[Callable] = None,
     head_params: Any = None,
+    remat_policy: Optional[str] = None,
 ):
     """Interleaved virtual-pipeline schedule
     (ref: fwd_bwd_pipelining_with_interleaving.py:26-415).
@@ -821,8 +837,10 @@ def forward_backward_pipelining_with_interleaving(
     device s is logical stage ``v*S + s`` — Megatron's chunk placement. The
     number of microbatches must be a multiple of the pipe size (the
     reference's assert). Returns ``(loss, grads)`` with grads leading with V
-    (or ``PipelineGrads`` when embed/head are given).
+    (or ``PipelineGrads`` when embed/head are given). ``remat_policy``:
+    named remat policy applied per stage chunk (see the 1F1B docstring).
     """
+    stage_fn = _remat_apply(stage_fn, remat_policy)
     V = virtual_pipeline_model_parallel_size
     bad = [leaf.shape for leaf in jax.tree.leaves(chunk_params) if leaf.shape[0] != V]
     if bad:

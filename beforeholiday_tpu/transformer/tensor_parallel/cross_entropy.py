@@ -6,6 +6,16 @@ backward ``softmax - onehot`` computed from saved residuals. Implemented as a
 custom VJP over ``pmax``/``psum`` so the collective transposes are pinned
 (see mappings.py rationale), with the reference's optional label smoothing
 (:80-89).
+
+Activation-memory knob: ``save_softmax=False`` drops the materialized
+``(..., vocab/world)`` fp32 local softmax from the residuals — the dominant
+large-vocab activation — and keeps only the ``(...,)`` row statistics
+``(xmax, sum_ex)`` plus the (typically half-precision) logits; the backward
+rebuilds ``softmax_local = exp(logits - xmax) / sum_ex`` bitwise-identically
+(same exp on the same inputs) before forming ``softmax - onehot``. That
+trades one elementwise exp re-run for ~4x the vocab-shard bytes (fp32
+softmax vs bf16 logits), the same save-the-statistics trade the flash
+attention backward makes with ``lse``.
 """
 
 from __future__ import annotations
@@ -21,7 +31,7 @@ from beforeholiday_tpu.transformer.tensor_parallel.layers import vocab_range
 
 
 def _fwd_math(logits, target, vocab_size, axis_name):
-    """Returns (loss, (softmax_local, target_mask_local, local_idx))."""
+    """Returns (loss, softmax_local, (in_range, local_idx), (xmax, sum_ex))."""
     x = logits.astype(jnp.float32)
     # 1. global max for stability (allreduce MAX, ref :31-36)
     xmax = comms.pmax(jnp.max(x, axis=-1), axis_name,
@@ -40,23 +50,45 @@ def _fwd_math(logits, target, vocab_size, axis_name):
     tgt = comms.psum(tgt, axis_name, site="tp.vocab_cross_entropy")
     loss = jnp.log(sum_ex) - tgt
     softmax_local = ex / sum_ex[..., None]
-    return loss, (softmax_local, in_range, local_idx)
+    return loss, softmax_local, (in_range, local_idx), (xmax, sum_ex)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def vocab_parallel_cross_entropy(
     logits: jax.Array,  # (..., vocab/world) local shard
     target: jax.Array,  # (...,) int global vocab ids
     vocab_size: int,
     label_smoothing: float = 0.0,
     axis_name: str = TENSOR_AXIS,
+    *,
+    save_softmax: bool = True,
 ) -> jax.Array:
-    """Per-token CE loss over vocab-sharded logits. Returns (...,) fp32."""
-    return _ce_fwd(logits, target, vocab_size, label_smoothing, axis_name)[0]
+    """Per-token CE loss over vocab-sharded logits. Returns (...,) fp32.
+
+    ``save_softmax=False`` saves the ``(xmax, sum_ex)`` row statistics
+    instead of the full local softmax and recomputes ``softmax - onehot`` in
+    the backward (see module docstring) — same values, smaller residuals.
+    """
+    # the primal dtype is static at trace time; passing it as a nondiff
+    # argument lets the backward cast the logits cotangent without smuggling
+    # a zero-size dtype sentinel through the residuals
+    return _ce(
+        logits, target, vocab_size, float(label_smoothing), axis_name,
+        bool(save_softmax), jnp.dtype(logits.dtype),
+    )
 
 
-def _ce_fwd(logits, target, vocab_size, label_smoothing, axis_name):
-    loss, (softmax_local, in_range, local_idx) = _fwd_math(
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _ce(logits, target, vocab_size, label_smoothing, axis_name,
+        save_softmax, grad_dtype):
+    return _ce_fwd(
+        logits, target, vocab_size, label_smoothing, axis_name,
+        save_softmax, grad_dtype,
+    )[0]
+
+
+def _ce_fwd(logits, target, vocab_size, label_smoothing, axis_name,
+            save_softmax, grad_dtype):
+    loss, softmax_local, (in_range, local_idx), (xmax, sum_ex) = _fwd_math(
         logits, target, vocab_size, axis_name
     )
     if label_smoothing > 0:
@@ -66,14 +98,26 @@ def _ce_fwd(logits, target, vocab_size, label_smoothing, axis_name):
             site="tp.vocab_cross_entropy",
         ) / vocab_size
         loss = (1.0 - label_smoothing) * loss - label_smoothing * mean_log
-    # zero-size sentinel carries the primal dtype through the residuals
-    return loss, (softmax_local, in_range, local_idx, jnp.zeros((0,), logits.dtype))
+    if save_softmax:
+        # fast-backward residuals: the materialized (..., vocab/world) fp32
+        # local softmax (the reference's choice, ref :62 ``save_for_backward``)
+        res = (softmax_local, in_range, local_idx)
+    else:
+        # slim residuals: logits + (...,) row stats; backward re-runs the exp
+        res = (logits, xmax, sum_ex, in_range, local_idx)
+    return loss, res
 
 
-def _ce_bwd(vocab_size, label_smoothing, axis_name, res, dy):
+def _ce_bwd(vocab_size, label_smoothing, axis_name, save_softmax, grad_dtype,
+            res, dy):
     """grad = softmax - onehot (ref :91-103), smoothed variant included."""
-    softmax_local, in_range, local_idx, dtype_sentinel = res
-    dtype = dtype_sentinel.dtype
+    if save_softmax:
+        softmax_local, in_range, local_idx = res
+    else:
+        logits, xmax, sum_ex, in_range, local_idx = res
+        # identical exp on identical inputs -> bitwise-equal softmax_local
+        ex = jnp.exp(logits.astype(jnp.float32) - xmax[..., None])
+        softmax_local = ex / sum_ex[..., None]
     onehot = jnp.zeros_like(softmax_local)
     upd = in_range.astype(jnp.float32)
     onehot = jnp.put_along_axis(
@@ -86,7 +130,7 @@ def _ce_bwd(vocab_size, label_smoothing, axis_name, res, dy):
         )
     else:
         grad = softmax_local - onehot
-    return (grad * dy[..., None]).astype(dtype), None
+    return (grad * dy[..., None]).astype(grad_dtype), None
 
 
-vocab_parallel_cross_entropy.defvjp(_ce_fwd, _ce_bwd)
+_ce.defvjp(_ce_fwd, _ce_bwd)

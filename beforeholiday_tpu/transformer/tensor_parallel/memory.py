@@ -2,10 +2,17 @@
 
 The reference preallocates one flat CUDA tensor and hands out zero-copy views
 to avoid allocator churn for activation-sized temporaries. XLA owns TPU memory
-— buffers are placed/reused by the compiler, and donation (``jax.jit(...,
-donate_argnums=...)``) covers in-place reuse — so this port keeps the API as a
-*view allocator over a flat arena* for code structured around it, while the
-docstring is explicit that it is not a performance lever on TPU.
+— buffers are placed/reused by the compiler — so this port keeps the API as a
+*view allocator over a flat arena* for code structured around it; it is not a
+performance lever on TPU.
+
+The actual in-place-reuse lever here is buffer donation, and it has a real
+helper now: :func:`beforeholiday_tpu.remat.donation.donate_step` wires
+``jax.jit(..., donate_argnums=...)`` into a step function (and warns once
+when a fused-optimizer ``PackedParams`` arena is passed undonated);
+:func:`~beforeholiday_tpu.remat.donation.donate_optimizer_step` does the
+same for a fused optimizer's ``step``. Both are re-exported below at the
+reference's module path for Apex-API parity.
 """
 
 from __future__ import annotations
@@ -15,6 +22,11 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+from beforeholiday_tpu.remat.donation import (  # noqa: F401  (re-export)
+    donate_optimizer_step,
+    donate_step,
+)
 
 
 class MemoryBuffer:

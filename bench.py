@@ -863,6 +863,30 @@ def bench_comms_overhead():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_remat_sweep():
+    """Remat-policy sweep (temp bytes + step time per checkpoint policy) on a
+    CPU subprocess — the temp-byte numbers are XLA's own static
+    ``memory_analysis()`` and therefore exact; the step times are CPU
+    proxies. Same env scrub as ``bench_pp_overhead`` (the axon sitecustomize
+    would otherwise register the TPU backend and the sweep would time the
+    tunnel)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.remat_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"remat_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------------
@@ -1091,6 +1115,23 @@ def main():
             "bitwise-checked vs monolithic in-process; overlap and wire-byte "
             "wins need real ICI"
         )
+
+    # --- remat-policy sweep (CPU proxy, subprocess) ---
+    remat_res = _stage(detail, bench_remat_sweep)
+    if remat_res:
+        for k, v in remat_res.items():
+            if k.startswith(("peak_temp_bytes_", "remat_")):
+                detail[k] = v
+        detail["remat_memory_summary"] = remat_res.get("memory_summary")
+        detail["remat_config"] = remat_res.get("config")
+        detail["remat_note"] = (
+            "remat sweep on a CPU subprocess: temp bytes are XLA "
+            "memory_analysis() (exact, backend-static); step times are CPU "
+            "proxies for the recompute tax, not TPU numbers"
+        )
+        # the child's second-pass timings ride the same stability gate as
+        # every other measured-twice key
+        pass2.update(remat_res.get("pass2") or {})
 
     # --- guard dispatch + comms + compile counters: what every rung above
     # actually dispatched/communicated/compiled (collected LAST so the

@@ -13,6 +13,7 @@ Synthetic data; run ``python examples/dcgan/main_amp.py --iters 20``.
 from __future__ import annotations
 
 import argparse
+import functools
 from typing import Any
 
 import jax
@@ -21,6 +22,7 @@ import numpy as np
 
 from beforeholiday_tpu import amp
 from beforeholiday_tpu.optimizers import FusedAdam
+from beforeholiday_tpu.remat import donate_step
 
 IMG = 32
 NZ = 64
@@ -106,7 +108,9 @@ def build(opt_level="O2", lr=2e-4, seed=0):
 
 
 def make_train_step(d: Any, g: Any):
-    @jax.jit
+    # both models' params/opt/scaler states (args 0-4) are donated: the main
+    # loop rebinds all five every iteration
+    @functools.partial(donate_step, donate_argnums=(0, 1, 2, 3, 4))
     def train_step(dp, gp, d_opt, g_opt, scalers, real, z):
         s_real, s_fake, s_gen = scalers
 

@@ -41,6 +41,7 @@ from beforeholiday_tpu import amp
 from beforeholiday_tpu.models import resnet
 from beforeholiday_tpu.optimizers import FusedSGD
 from beforeholiday_tpu.parallel import DistributedDataParallel, LARC
+from beforeholiday_tpu.remat import donate_step
 
 # ImageNet channel stats, in 0-255 space like the reference prefetcher
 # (main_amp.py:269-270)
@@ -223,21 +224,25 @@ def build_trainer(
             m = {k: jax.lax.pmean(v, "data") for k, v in m.items()}
         return m
 
+    # params/opt/scaler/BN state (args 0-3) are donated: Trainer.step rebinds
+    # them from the outputs, so XLA may alias the update in place instead of
+    # holding both copies of the largest buffers live across the step
+    _donate = (0, 1, 2, 3)
     if distributed:
         rep = P()
-        train_step = jax.jit(jax.shard_map(
+        train_step = donate_step(jax.shard_map(
             core_step, mesh=mesh,
             in_specs=(rep, rep, rep, rep, P("data"), P("data"), rep),
             out_specs=(rep, rep, rep, rep, rep),
             check_vma=False,
-        ))
+        ), donate_argnums=_donate)
         eval_step = jax.jit(jax.shard_map(
             core_eval, mesh=mesh,
             in_specs=(rep, rep, P("data"), P("data")),
             out_specs=rep, check_vma=False,
         ))
     else:
-        train_step = jax.jit(core_step)
+        train_step = donate_step(core_step, donate_argnums=_donate)
         eval_step = jax.jit(core_eval)
 
     opt_state = optimizer.init(amp_model.params) if optimizer is not None else None

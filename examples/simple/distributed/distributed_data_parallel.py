@@ -36,6 +36,7 @@ else:
 from beforeholiday_tpu import amp
 from beforeholiday_tpu.optimizers import FusedSGD
 from beforeholiday_tpu.parallel import DistributedDataParallel
+from beforeholiday_tpu.remat import donate_step
 
 N, D_in, D_out = 64, 1024, 16  # per-rank batch, like the reference's fake data
 
@@ -68,7 +69,9 @@ def main():
 
     svag = amp.scaled_value_and_grad(loss_fn, model.scaler, reduce_grads=ddp.reduce)
 
-    @jax.jit
+    # (state, scaler_state) donated: the loop rebinds both every step, so XLA
+    # updates params/opt/scaler storage in place instead of double-buffering
+    @functools.partial(donate_step, donate_argnums=(0, 1))
     @functools.partial(
         _shard_map, mesh=mesh,
         in_specs=(P(), P(), P("data"), P("data")),

@@ -87,7 +87,9 @@ def _run_resnet(opt_level, keep_bn, loss_scale, opt_name):
         opt_level=opt_level, keep_batchnorm_fp32=keep_bn, loss_scale=loss_scale,
         distributed=False, seed=0, fused_optimizer=opt, lr=0.02,
     )
-    params0 = trainer.params
+    # host snapshot, not a reference: the trainer's donated step consumes the
+    # initial params buffer on step 1 (the drift oracle needs the VALUES)
+    params0 = jax.tree.map(lambda x: np.asarray(x).copy(), trainer.params)
     losses = []
     for images, labels in main_amp.synthetic_batches(16, 32, 10, _STEPS, seed=7):
         m = trainer.step(jnp.asarray(images), jnp.asarray(labels), 0.02)

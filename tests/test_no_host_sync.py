@@ -165,3 +165,24 @@ def test_bucketing_is_scanned():
     # and no monitor file carries a (file, func) waiver — the sanction list
     # above is the entire exception surface for the subsystem
     assert not [k for k in _WAIVED if k[0].startswith("monitor/")]
+
+
+def test_remat_and_memory_ledger_are_scanned():
+    """remat/ (policies + donation) and the memory ledger promise host-side
+    metadata work ONLY (shapes, treedefs, compiler stats — never a traced
+    value): pin that the scanner reaches all of them with zero sanctions and
+    zero waivers."""
+    remat_files = sorted(
+        p.relative_to(_PKG_ROOT).as_posix()
+        for p in (_PKG_ROOT / "remat").rglob("*.py")
+    )
+    assert "remat/policies.py" in remat_files
+    assert "remat/donation.py" in remat_files
+    assert "remat" not in _SKIP_DIRS
+    assert not any(path.startswith("remat/") for path in _SANCTIONED_BY_FILE)
+    assert not any(path.startswith("remat/") for path, _ in _WAIVED)
+    # the ledger lives in monitor/ and must be clean — the monitor sanction
+    # set (export/trace) must NOT have grown to admit it
+    assert "monitor/memory.py" not in _SANCTIONED_BY_FILE
+    assert not [k for k in _WAIVED if k[0] == "monitor/memory.py"]
+    assert (_PKG_ROOT / "monitor" / "memory.py").exists()
