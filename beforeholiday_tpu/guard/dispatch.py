@@ -53,6 +53,12 @@ _PROBE_MODE = "auto"  # "auto" | "compile" | "trace" | "off"
 # _VERDICTS_LOCK; queried via monitor.counters / dispatch_counters().
 _COUNTERS: Dict[Tuple, Dict[str, int]] = {}
 
+# every warn_once key this module has fired — clear_probe_cache only resets
+# keys still holding a verdict, so without this record a key warned and then
+# dropped (or a full-registry reset) leaks stale warn-once state in long
+# sessions. Guarded by _VERDICTS_LOCK; drained by reset_probe_warnings().
+_WARNED_KEYS: Set[Tuple] = set()
+
 
 def _count(key: Tuple, outcome: str, probed: bool = False) -> None:
     # caller holds _VERDICTS_LOCK
@@ -100,8 +106,22 @@ def clear_probe_cache(op_name: Optional[str] = None) -> None:
             dropped = [k for k in _VERDICTS if k[0] == op_name]
             for key in dropped:
                 del _VERDICTS[key]
+        for key in dropped:
+            _WARNED_KEYS.discard(("guard.dispatch",) + key)
     for key in dropped:
         reset_warn_once(("guard.dispatch",) + key)
+
+
+def reset_probe_warnings() -> None:
+    """Re-arm EVERY probe-failure warning this module has ever emitted —
+    including keys whose verdicts were already dropped, which
+    :func:`clear_probe_cache` cannot reach. ``monitor.reset_counters`` calls
+    this so a counter reset leaves no stale warn-once state behind."""
+    with _VERDICTS_LOCK:
+        warned = list(_WARNED_KEYS)
+        _WARNED_KEYS.clear()
+    for full_key in warned:
+        reset_warn_once(full_key)
 
 
 def probe_failures() -> Dict[Tuple, str]:
@@ -200,6 +220,7 @@ def checked_impl(
         with _VERDICTS_LOCK:
             _VERDICTS.setdefault(key, summary)
             _count(key, "jnp", probed=True)
+            _WARNED_KEYS.add(("guard.dispatch",) + key)
         # warn_once dedups per key (clear_probe_cache resets it with the
         # verdict, so a re-probe of the same key may warn again)
         warn_once(

@@ -70,6 +70,20 @@ _HEALTH_KEYS = (
 )
 
 
+def health_summary(health: Dict[str, Any]) -> Dict[str, Any]:
+    """Human-readable rendering of an ALREADY-FETCHED health/metrics row
+    (a ``MetricsLogger`` row, a ``state_dict()["health"]`` — host numbers,
+    never traced values): the health keys present, plus the skip-reason code
+    decoded to its name. The flight recorder stamps this onto its dumps."""
+    out = {k: health[k] for k in _HEALTH_KEYS if k in health}
+    reason = health.get("last_skip_reason")
+    if reason is not None:
+        out["last_skip_reason_name"] = SKIP_REASON_NAMES.get(
+            int(reason), f"unknown({reason})"
+        )
+    return out
+
+
 def _tree_nonfinite(tree) -> jax.Array:
     """True iff any inexact leaf holds a non-finite value."""
     flags = [

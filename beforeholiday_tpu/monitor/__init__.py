@@ -21,6 +21,13 @@ Split by which side of the device boundary each piece lives on:
   (``track_compiles``: count signatures per jitted entry, warn on storms).
 * :mod:`beforeholiday_tpu.monitor.memory`   — per-jit memory ledger
   (``track_memory``: AOT ``memory_analysis()`` bytes per entry/signature).
+* :mod:`beforeholiday_tpu.monitor.roofline` — roofline/MFU ledger
+  (``track_costs``: AOT ``cost_analysis()`` FLOPs/bytes per entry joined
+  with measured wall time; ``perf_report`` is the one-call rollup).
+* :mod:`beforeholiday_tpu.monitor.overlap`  — measured compute/comms
+  overlap fraction and cross-rank straggler skew over the timeline.
+* :mod:`beforeholiday_tpu.monitor.flight`   — crash flight recorder
+  (ring buffer of drained steps, dumped on StepGuard rollback / crash).
 """
 
 # NOTE on the name ``trace``: importing the ``monitor.trace`` SUBMODULE below
@@ -51,7 +58,9 @@ from beforeholiday_tpu.monitor.metrics import (  # noqa: F401
 from beforeholiday_tpu.monitor.export import MetricsLogger  # noqa: F401
 from beforeholiday_tpu.monitor.counters import (  # noqa: F401
     dispatch_counters,
+    dispatch_records,
     dispatch_summary,
+    reset_counters,
     reset_dispatch_counters,
 )
 from beforeholiday_tpu.monitor.comms import (  # noqa: F401
@@ -73,36 +82,82 @@ from beforeholiday_tpu.monitor.memory import (  # noqa: F401
     reset_memory_ledger,
     track_memory,
 )
+from beforeholiday_tpu.monitor.roofline import (  # noqa: F401
+    ChipSpec,
+    chip_specs,
+    estimate_costs,
+    get_chip_spec,
+    join_spans,
+    measure_costs,
+    perf_report,
+    record_wall_time,
+    register_chip_spec,
+    reset_roofline_ledger,
+    roofline_records,
+    roofline_summary,
+    track_costs,
+)
+from beforeholiday_tpu.monitor.overlap import (  # noqa: F401
+    overlap_report,
+    rank_skew,
+    span_intervals,
+    straggler_report,
+)
+from beforeholiday_tpu.monitor.flight import (  # noqa: F401
+    FlightRecorder,
+    active_flight_recorder,
+)
 
 __all__ = [
+    "ChipSpec",
+    "FlightRecorder",
     "Metrics",
     "MetricsLogger",
     "Timers",
     "TraceRecorder",
     "TrainMonitor",
+    "active_flight_recorder",
     "active_recorder",
     "annotate",
+    "chip_specs",
     "comms_records",
     "comms_summary",
     "compile_counts",
     "compile_summary",
     "dispatch_counters",
+    "dispatch_records",
     "dispatch_summary",
+    "estimate_costs",
+    "get_chip_spec",
     "global_norm",
+    "join_spans",
     "ledger_scope",
+    "measure_costs",
     "measure_memory",
     "memory_records",
     "memory_summary",
     "nvtx_range",
+    "overlap_report",
+    "perf_report",
+    "rank_skew",
+    "record_wall_time",
+    "register_chip_spec",
     "reset_comms_ledger",
     "reset_compile_counts",
+    "reset_counters",
     "reset_dispatch_counters",
     "reset_memory_ledger",
+    "reset_roofline_ledger",
+    "roofline_records",
+    "roofline_summary",
     "span",
+    "span_intervals",
     "start_trace",
     "stop_trace",
+    "straggler_report",
     "timeline",
     "trace",
     "track_compiles",
+    "track_costs",
     "track_memory",
 ]

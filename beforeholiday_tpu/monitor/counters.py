@@ -19,9 +19,16 @@ from typing import Dict, List, Tuple
 
 __all__ = [
     "dispatch_counters",
+    "dispatch_records",
     "dispatch_summary",
+    "reset_counters",
     "reset_dispatch_counters",
 ]
+
+
+def _ratio(pallas: int, jnp: int) -> float:
+    total = pallas + jnp
+    return round(pallas / total, 4) if total else 0.0
 
 
 def dispatch_counters() -> Dict[Tuple, Dict[str, int]]:
@@ -39,10 +46,49 @@ def reset_dispatch_counters() -> None:
     _dispatch.reset_dispatch_counters()
 
 
+def reset_counters() -> None:
+    """Full dispatch-telemetry reset: zero the per-key counters AND re-arm
+    every probe-failure warning the dispatcher has emitted.
+    ``reset_dispatch_counters`` alone leaves stale warn-once state behind
+    (``clear_probe_cache`` only resets keys still holding a verdict), which
+    leaks across long sessions — this is the one-call clean slate between
+    benchmark configurations."""
+    from beforeholiday_tpu.guard import dispatch as _dispatch
+
+    _dispatch.reset_dispatch_counters()
+    _dispatch.reset_probe_warnings()
+
+
+def dispatch_records() -> List[Dict[str, object]]:
+    """Per-key JSON-ready rows (one per (op, backend, shapes, statics) key):
+    ``{"op", "key", "pallas", "jnp", "probes", "pallas_ratio", "degraded"}``
+    — ``pallas_ratio`` is this key's pallas-hit fraction of its dispatches."""
+    from beforeholiday_tpu.guard import dispatch as _dispatch
+
+    failed = set(_dispatch.probe_failures())
+    return sorted(
+        (
+            {
+                "op": key[0],
+                "key": repr(key[1:]),
+                "pallas": c["pallas"],
+                "jnp": c["jnp"],
+                "probes": c["probes"],
+                "pallas_ratio": _ratio(c["pallas"], c["jnp"]),
+                "degraded": key in failed,
+            }
+            for key, c in _dispatch.dispatch_counters().items()
+        ),
+        key=lambda r: (r["op"], r["key"]),
+    )
+
+
 def dispatch_summary() -> List[Dict[str, object]]:
     """Op-level rollup, one JSON-ready row per op name:
-    ``{"op", "keys", "pallas", "jnp", "probes", "degraded_keys"}`` — the
-    shape ``bench.py`` embeds in its emitted line."""
+    ``{"op", "keys", "pallas", "jnp", "probes", "pallas_ratio",
+    "degraded_keys"}`` — the shape ``bench.py`` embeds in its emitted line
+    (``pallas_ratio`` = fraction of the op's dispatches that took the
+    kernel; 1.0 is a fully-healthy op, 0.0 a fully-degraded one)."""
     from beforeholiday_tpu.guard import dispatch as _dispatch
 
     per_key = _dispatch.dispatch_counters()
@@ -60,4 +106,6 @@ def dispatch_summary() -> List[Dict[str, object]]:
         row["probes"] += c["probes"]
         if key in failed:
             row["degraded_keys"] += 1
+    for row in by_op.values():
+        row["pallas_ratio"] = _ratio(row["pallas"], row["jnp"])
     return sorted(by_op.values(), key=lambda r: r["op"])

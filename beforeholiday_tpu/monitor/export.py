@@ -18,6 +18,7 @@ AST no-host-sync check as ``drain``/``flush``/``_fetch``).
 
 from __future__ import annotations
 
+import atexit
 import csv
 import json
 from typing import Any, Callable, Dict, Optional, Union
@@ -105,6 +106,12 @@ class MetricsLogger:
             return
         if self._file is None:
             self._file = open(self.path, "a")
+            # crash-flush: an every=N cadence can leave rows sitting in the
+            # stdio buffer when the run dies mid-step — flush at interpreter
+            # exit so the partial log survives an uncaught exception
+            # (unregistered again in close(); re-registering the same bound
+            # method is a no-op for atexit)
+            atexit.register(self.flush)
         if self.fmt == "jsonl":
             self._file.write(json.dumps(row) + "\n")
         else:
@@ -125,11 +132,14 @@ class MetricsLogger:
             self._file.close()
             self._file = None
             self._csv_writer = None
+            atexit.unregister(self.flush)
 
     def __enter__(self) -> "MetricsLogger":
         return self
 
     def __exit__(self, *exc) -> None:
+        # close() flushes via file.close(); an exception leaving the block
+        # still gets its buffered rows on disk
         self.close()
 
     # ------------------------------------------------------------- warnings

@@ -1,0 +1,267 @@
+"""Flight recorder — a bounded black box of the last N drained steps, dumped
+as one structured JSON when a run dies.
+
+PR 1's ``StepGuard`` turns a poisoned step into a skip, a shrinking loss
+scale, and eventually a rollback — but by the time an operator looks, the
+warning scrolled away and the state that explains it is gone. The flight
+recorder keeps the recent past on host: a ring buffer (``deque(maxlen=N)``)
+of drained step snapshots — the metrics row plus rolled-up comms/dispatch/
+compile counter totals — costing O(N) host dicts and ZERO device work (it
+consumes rows ``MetricsLogger`` already fetched; it never reads the device
+itself, so the no-host-sync scan sanctions only :meth:`FlightRecorder.dump`,
+the one file write).
+
+Two triggers turn the ring into an artifact:
+
+* **StepGuard rollback trip** — :meth:`record` watches ``rollbacks_total``
+  in the drained rows; the step where it increments dumps automatically
+  (``reason="stepguard_rollback"``), loss-scale trajectory and all.
+* **Interpreter exit after an exception** — :meth:`arm_crash_dump` chains
+  ``sys.excepthook`` (dump first, then the previous hook); using the
+  recorder as a context manager dumps on the way out of a raising block and
+  disarms on clean exit.
+
+Usage::
+
+    logger = monitor.MetricsLogger(mon, path="metrics.jsonl")
+    with monitor.FlightRecorder(capacity=64, path="flight.json").attach(logger):
+        for step in range(n):
+            ..., packed = train_step(...)
+            logger.log(packed, step)     # each drained row lands in the ring
+    # crash anywhere in the block -> flight.json holds the last 64 steps
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from beforeholiday_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "FlightRecorder",
+    "active_flight_recorder",
+]
+
+
+def _counter_totals() -> Dict[str, Any]:
+    """Light per-snapshot rollup of the process-global counter state (host
+    dict arithmetic only — every value is already a Python number)."""
+    from beforeholiday_tpu.monitor.comms import comms_records
+    from beforeholiday_tpu.monitor.compile import compile_counts
+    from beforeholiday_tpu.monitor.counters import dispatch_counters
+
+    disp = dispatch_counters().values()
+    comms = comms_records()
+    compiles = compile_counts().values()
+    return {
+        "dispatch_pallas": sum(c["pallas"] for c in disp),
+        "dispatch_jnp": sum(c["jnp"] for c in disp),
+        "dispatch_probes": sum(c["probes"] for c in disp),
+        "comms_calls": sum(r["calls"] for r in comms),
+        "comms_bytes": sum(r["bytes"] for r in comms),
+        "compile_signatures": sum(c["signatures"] for c in compiles),
+        "compile_calls": sum(c["calls"] for c in compiles),
+    }
+
+
+class FlightRecorder:
+    """Ring buffer of drained step snapshots + crash/rollback dump triggers.
+
+    Parameters
+    ----------
+    capacity: ring size — how many recent steps the black box keeps.
+    path: default dump destination (a per-dump override wins).
+    auto_dump_on_rollback: dump when a recorded row's ``rollbacks_total``
+        increments (the StepGuard trip); each trip dumps once.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 64,
+        *,
+        path: str = "flight_recorder.json",
+        auto_dump_on_rollback: bool = True,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.path = path
+        self.auto_dump_on_rollback = bool(auto_dump_on_rollback)
+        self.dumps: List[str] = []
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._prev_rollbacks: Optional[float] = None
+        self._prev_hook = None
+        self._armed = False
+
+    # ------------------------------------------------------------- recording
+    def record(
+        self,
+        step: int,
+        row: Dict[str, Any],
+        *,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Append one drained step to the ring. ``row`` is a HOST dict (a
+        ``MetricsLogger`` row / ``unpack_host`` output — already fetched);
+        counter totals are snapshotted alongside it. Detects the StepGuard
+        rollback trip via ``rollbacks_total`` increments."""
+        snap: Dict[str, Any] = {
+            "step": step,
+            "metrics": dict(row),
+            "counters": _counter_totals(),
+        }
+        if extra:
+            snap["extra"] = dict(extra)
+        rollbacks = row.get("rollbacks_total")
+        tripped = False
+        with self._lock:
+            self._ring.append(snap)
+            if rollbacks is not None:
+                prev = self._prev_rollbacks
+                tripped = prev is not None and rollbacks > prev
+                self._prev_rollbacks = rollbacks
+        if tripped and self.auto_dump_on_rollback:
+            self.dump(reason="stepguard_rollback")
+
+    def attach(self, metrics_logger) -> "FlightRecorder":
+        """Chain into a ``MetricsLogger``: every drained row is recorded here
+        before reaching the logger's existing callback. Returns self (so
+        ``with FlightRecorder(...).attach(logger):`` reads naturally)."""
+        prev_cb = metrics_logger.callback
+
+        def _cb(step: int, row: Dict[str, Any]) -> None:
+            self.record(step, row)
+            if prev_cb is not None:
+                prev_cb(step, row)
+
+        metrics_logger.callback = _cb
+        return self
+
+    # -------------------------------------------------------------- queries
+    def snapshots(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(s) for s in self._ring]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # ------------------------------------------------------------- the dump
+    def dump(
+        self, path: Optional[str] = None, *, reason: str = "manual"
+    ) -> str:
+        """Write the black box: ring snapshots, loss-scale trajectory, the
+        decoded last health state, and full dispatch/comms/compile/probe
+        summaries. The module's ONE sanctioned write path (host dicts only —
+        nothing here reads a device value). Returns the path written."""
+        from beforeholiday_tpu.guard.step import health_summary
+        from beforeholiday_tpu.monitor.comms import comms_summary
+        from beforeholiday_tpu.monitor.compile import compile_summary
+        from beforeholiday_tpu.monitor.counters import dispatch_summary
+        from beforeholiday_tpu.guard.dispatch import probe_failures
+
+        snaps = self.snapshots()
+        payload: Dict[str, Any] = {
+            "reason": reason,
+            "created_unix": time.time(),
+            "capacity": self.capacity,
+            "n_snapshots": len(snaps),
+            "snapshots": snaps,
+            "loss_scale_trajectory": [
+                s["metrics"].get("loss_scale") for s in snaps
+            ],
+            "last_health": (
+                health_summary(snaps[-1]["metrics"]) if snaps else None
+            ),
+            "dispatch_summary": dispatch_summary(),
+            "comms_summary": comms_summary(),
+            "compile_summary": compile_summary(),
+            "probe_failures": {
+                repr(k): v for k, v in probe_failures().items()
+            },
+        }
+        out = path if path is not None else self.path
+        with open(out, "w") as f:
+            json.dump(payload, f)
+        self.dumps.append(out)
+        logger.warning(
+            "flight recorder dumped %d step snapshot(s) to %s (reason=%s)",
+            len(snaps), out, reason,
+        )
+        return out
+
+    # ----------------------------------------------------------- crash hooks
+    def arm_crash_dump(self) -> "FlightRecorder":
+        """Chain ``sys.excepthook``: an uncaught exception dumps the black
+        box (``reason="exception:<Type>"``) before the previous hook prints
+        the traceback. Idempotent; :meth:`disarm_crash_dump` restores."""
+        if self._armed:
+            return self
+        prev = sys.excepthook
+
+        def _hook(exc_type, exc, tb):
+            try:
+                self.dump(reason=f"exception:{exc_type.__name__}")
+            except Exception:  # noqa: BLE001 — never mask the original crash
+                logger.exception("flight-recorder dump failed in excepthook")
+            prev(exc_type, exc, tb)
+
+        self._prev_hook = prev
+        sys.excepthook = _hook
+        self._armed = True
+        return self
+
+    def disarm_crash_dump(self) -> None:
+        """Restore the previous excepthook (only if ours is still
+        installed — a later hook chained on top is left alone)."""
+        if not self._armed:
+            return
+        self._armed = False
+        if self._prev_hook is not None and sys.excepthook.__qualname__.startswith(
+            "FlightRecorder.arm_crash_dump"
+        ):
+            sys.excepthook = self._prev_hook
+        self._prev_hook = None
+
+    # ------------------------------------------------------- context manager
+    def __enter__(self) -> "FlightRecorder":
+        global _ACTIVE
+        self.arm_crash_dump()
+        with _ACTIVE_LOCK:
+            self._prev_active = _ACTIVE
+            _ACTIVE = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        global _ACTIVE
+        with _ACTIVE_LOCK:
+            _ACTIVE = self._prev_active
+        self.disarm_crash_dump()
+        if exc_type is not None:
+            # the exception is handled (or about to propagate past the
+            # excepthook we just removed) — dump here so the artifact exists
+            # even when an outer try swallows the error
+            try:
+                self.dump(reason=f"exception:{exc_type.__name__}")
+            except Exception:  # noqa: BLE001 — never mask the original error
+                logger.exception("flight-recorder dump failed in __exit__")
+
+
+# ------------------------------------------------------------ active recorder
+_ACTIVE: Optional[FlightRecorder] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_flight_recorder() -> Optional[FlightRecorder]:
+    """The recorder installed by the innermost ``with FlightRecorder(...)``
+    block (None outside one) — for library code that wants to annotate the
+    black box without threading a handle."""
+    return _ACTIVE

@@ -57,6 +57,7 @@ class TraceRecorder:
         self._events: List[Dict[str, Any]] = []
         self._pids: Dict[int, int] = {}  # rank -> pid (identity; dedup only)
         self._tids: Dict[int, int] = {}  # thread ident -> small tid
+        self._named_threads: set = set()  # (pid, tid) rows already named
         self._process_name = process_name
 
     # ------------------------------------------------------------- internals
@@ -80,7 +81,21 @@ class TraceRecorder:
                 })
             if ident not in self._tids:
                 self._tids[ident] = len(self._tids)
-        return rank, self._tids[ident]
+            tid = self._tids[ident]
+            if (rank, tid) not in self._named_threads:
+                # name every (process, thread) row so multi-rank traces load
+                # with deterministic, human-readable rows in Perfetto (tid 0
+                # is each rank's main recording thread)
+                self._named_threads.add((rank, tid))
+                self._events.append({
+                    "ph": "M", "name": "thread_name", "pid": rank,
+                    "tid": tid, "args": {"name": f"host-thread-{tid}"},
+                })
+                self._events.append({
+                    "ph": "M", "name": "thread_sort_index", "pid": rank,
+                    "tid": tid, "args": {"sort_index": tid},
+                })
+        return rank, tid
 
     def _append(self, ev: Dict[str, Any]) -> None:
         with self._lock:
@@ -128,13 +143,28 @@ class TraceRecorder:
         with self._lock:
             return [dict(e) for e in self._events]
 
+    def _export_events(self) -> List[Dict[str, Any]]:
+        """Deterministic export order: all ``M`` metadata rows first, sorted
+        by (pid, tid, name) so Perfetto assigns process/thread rows the same
+        order on every load, then the timed events sorted by timestamp
+        (stable — simultaneous events keep recording order)."""
+        events = self.events()
+        meta = [e for e in events if e.get("ph") == "M"]
+        timed = [e for e in events if e.get("ph") != "M"]
+        meta.sort(key=lambda e: (e.get("pid", 0), e.get("tid", 0),
+                                 e.get("name", "")))
+        timed.sort(key=lambda e: e.get("ts", 0.0))
+        return meta + timed
+
     # --------------------------------------------------------------- export
     def export(self, path: str) -> None:
         """Write ``{"traceEvents": [...]}`` — loads in Perfetto /
-        ``chrome://tracing`` as-is. The module's one sanctioned write path
-        (host-side data only; there is nothing to read back)."""
+        ``chrome://tracing`` as-is (metadata rows first, timed events in
+        timestamp order — see ``_export_events``). The module's one
+        sanctioned write path (host-side data only; there is nothing to
+        read back)."""
         payload = {
-            "traceEvents": self.events(),
+            "traceEvents": self._export_events(),
             "displayTimeUnit": "ms",
         }
         with open(path, "w") as f:

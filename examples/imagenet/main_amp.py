@@ -274,7 +274,8 @@ def synthetic_batches(global_batch, image_size, num_classes, n, seed=1234):
 
 
 def train(trainer: Trainer, *, iters: int, image_size: int = 224,
-          base_lr: float = 0.1, print_freq: int = 10, epoch: int = 0):
+          base_lr: float = 0.1, print_freq: int = 10, epoch: int = 0,
+          flight=None):
     """One synthetic 'epoch' of ``iters`` steps; prints reference-style lines."""
     num_classes = trainer.cfg.num_classes
     it = synthetic_batches(trainer.global_batch, image_size, num_classes, iters)
@@ -299,6 +300,10 @@ def train(trainer: Trainer, *, iters: int, image_size: int = 224,
                 f"Loss {metrics['loss']:.4f}  Prec@1 {metrics['prec1']:.2f}  "
                 f"Prec@5 {metrics['prec5']:.2f}  scale {metrics['scale']:.0f}"
             )
+            if flight is not None:
+                # snapshot only the rows the print cadence already
+                # host-synced — the recorder itself must not add readbacks
+                flight.record(epoch * iters + i + 1, metrics)
     return max(speeds) if speeds else 0.0
 
 
@@ -326,6 +331,10 @@ def parse_args(argv=None):
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--profile-dir", default=None,
                    help="write an XProf trace of one epoch here")
+    p.add_argument("--flight-recorder", default=None, metavar="PATH",
+                   help="keep a ring buffer of recent step metrics and dump "
+                        "it (with guard/comms/compile counters) to PATH on "
+                        "crash or exit")
     return p.parse_args(argv)
 
 
@@ -345,15 +354,33 @@ def main(argv=None):
     print(f"devices: {jax.device_count()}  distributed: {trainer.distributed}")
     from beforeholiday_tpu.utils.profiling import trace as profile_trace
 
+    flight = None
+    if args.flight_recorder:
+        from beforeholiday_tpu.monitor import FlightRecorder
+
+        # context-managed below: arms a crash dump (uncaught exception →
+        # ring dumped to PATH with counters + loss-scale trajectory) and
+        # dumps on exception exit too
+        flight = FlightRecorder(path=args.flight_recorder)
+
+    import contextlib
+
     best = 0.0
-    for epoch in range(args.epochs):
-        # trace exactly one epoch (the first), as the flag promises — tracing
-        # a whole multi-epoch run accumulates unloadable multi-GB profiles
-        with profile_trace(args.profile_dir if epoch == 0 else None):
-            best = max(best, train(
-                trainer, iters=args.iters, image_size=args.image_size,
-                base_lr=args.lr, print_freq=args.print_freq, epoch=epoch,
-            ))
+    with (flight if flight is not None else contextlib.nullcontext()):
+        for epoch in range(args.epochs):
+            # trace exactly one epoch (the first), as the flag promises —
+            # tracing a whole multi-epoch run accumulates unloadable multi-GB
+            # profiles
+            with profile_trace(args.profile_dir if epoch == 0 else None):
+                best = max(best, train(
+                    trainer, iters=args.iters, image_size=args.image_size,
+                    base_lr=args.lr, print_freq=args.print_freq, epoch=epoch,
+                    flight=flight,
+                ))
+    if flight is not None:
+        # the context manager dumps on exception; a clean run still writes
+        # the ring so the knob always leaves the file it promised
+        flight.dump(reason="run_end")
     print(f"peak speed: {best:.1f} img/s")
     return best
 

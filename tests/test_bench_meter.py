@@ -125,7 +125,8 @@ class TestMonitorExport:
             checked_impl("bench_op", "pallas", lambda x: x, jnp.ones((2,)))
             rows = dispatch_summary()
             assert rows and set(rows[0]) == {
-                "op", "keys", "pallas", "jnp", "probes", "degraded_keys"}
+                "op", "keys", "pallas", "jnp", "probes", "degraded_keys",
+                "pallas_ratio"}
             json.dumps(rows)
         finally:
             clear_probe_cache()

@@ -34,12 +34,15 @@ _SKIP_DIRS = frozenset({"testing", "models"})
 
 # file-scoped sanctioned functions: the monitor exporter's drain path is the
 # ONE host-side readback the observability contract allows (one fetch per
-# logged step, piggybacking on the step's existing scalar readback), and the
+# logged step, piggybacking on the step's existing scalar readback), the
 # trace recorder's ``export`` is its one file-write path (host dicts only —
-# it never reads a device value) — nothing else in monitor/ may sync
+# it never reads a device value), and the flight recorder's ``dump`` is the
+# crash-dump write path (it serializes already-drained host rows) — nothing
+# else in monitor/ may sync
 _SANCTIONED_BY_FILE = {
     "monitor/export.py": frozenset({"drain", "flush", "_fetch"}),
     "monitor/trace.py": frozenset({"export"}),
+    "monitor/flight.py": frozenset({"dump"}),
 }
 
 # file-scoped waivers for sync points that are part of a documented host-side
@@ -143,9 +146,33 @@ def test_monitor_package_is_scanned():
     assert "monitor/trace.py" in monitor_files
     assert "monitor/compile.py" in monitor_files
     assert "monitor" not in _SKIP_DIRS
-    assert set(_SANCTIONED_BY_FILE) == {"monitor/export.py", "monitor/trace.py"}
+    assert set(_SANCTIONED_BY_FILE) == {
+        "monitor/export.py", "monitor/trace.py", "monitor/flight.py",
+    }
     assert _SANCTIONED_BY_FILE["monitor/export.py"] == {"drain", "flush", "_fetch"}
     assert _SANCTIONED_BY_FILE["monitor/trace.py"] == {"export"}
+    assert _SANCTIONED_BY_FILE["monitor/flight.py"] == {"dump"}
+
+
+def test_perf_attribution_files_are_scanned():
+    """The perf-attribution trio (roofline ledger, overlap engine, flight
+    recorder) promises host-side arithmetic over already-drained data — the
+    scanner must reach all three, and only the flight recorder's ``dump``
+    (its one crash-dump write path) is sanctioned; roofline/overlap get NO
+    sanctions and NO waivers."""
+    monitor_files = sorted(
+        p.relative_to(_PKG_ROOT).as_posix()
+        for p in (_PKG_ROOT / "monitor").rglob("*.py")
+    )
+    assert "monitor/roofline.py" in monitor_files
+    assert "monitor/overlap.py" in monitor_files
+    assert "monitor/flight.py" in monitor_files
+    assert "monitor/roofline.py" not in _SANCTIONED_BY_FILE
+    assert "monitor/overlap.py" not in _SANCTIONED_BY_FILE
+    assert _SANCTIONED_BY_FILE["monitor/flight.py"] == {"dump"}
+    assert not [k for k in _WAIVED if k[0] in (
+        "monitor/roofline.py", "monitor/overlap.py", "monitor/flight.py",
+    )]
 
 
 def test_bucketing_is_scanned():

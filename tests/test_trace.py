@@ -15,6 +15,7 @@
 
 import json
 import logging
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -286,6 +287,103 @@ class TestTimeline:
                 assert active_recorder() is inner
             assert active_recorder() is outer
         assert active_recorder() is None
+
+
+class TestPerfettoMetadata:
+    """Perfetto loads a trace by its metadata rows: every (process, thread)
+    pair needs a ``thread_name``/``thread_sort_index`` row or multi-rank
+    traces render as anonymous swimlanes in arbitrary order. These pin the
+    row naming and the deterministic export ordering."""
+
+    def _cross_rank_trace(self):
+        """Nested spans on rank 0 overlapping in wall time with rank 1."""
+        rec = monitor.TraceRecorder()
+        rec.begin("step", rank=0)
+        rec.begin("fwd", rank=0)
+        rec.begin("step", rank=1)          # overlaps rank 0's open spans
+        rec.end(rank=0)                    # close fwd
+        rec.begin("psum:ddp.grads", rank=1)
+        rec.end(rank=1)
+        rec.end(rank=0)                    # close rank 0's step
+        rec.end(rank=1)                    # close rank 1's step
+        return rec
+
+    def test_every_rank_thread_pair_is_named_once(self):
+        rec = self._cross_rank_trace()
+        meta = [e for e in rec.events() if e["ph"] == "M"]
+        by_name = {}
+        for e in meta:
+            by_name.setdefault(e["name"], []).append(e)
+        # one process_name + process_sort_index per rank, sort_index == pid
+        assert {(e["pid"], e["args"]["name"]) for e in by_name["process_name"]} \
+            == {(0, "beforeholiday_tpu rank 0"), (1, "beforeholiday_tpu rank 1")}
+        assert {(e["pid"], e["args"]["sort_index"])
+                for e in by_name["process_sort_index"]} == {(0, 0), (1, 1)}
+        # one thread_name/thread_sort_index per (pid, tid) — both ranks
+        # record from this test's single host thread, so tid is 0 everywhere
+        assert {(e["pid"], e["tid"], e["args"]["name"])
+                for e in by_name["thread_name"]} \
+            == {(0, 0, "host-thread-0"), (1, 0, "host-thread-0")}
+        assert {(e["pid"], e["tid"], e["args"]["sort_index"])
+                for e in by_name["thread_sort_index"]} == {(0, 0, 0), (1, 0, 0)}
+        # repeated spans must not re-emit metadata
+        rec.begin("again", rank=0)
+        rec.end(rank=0)
+        assert len([e for e in rec.events() if e["ph"] == "M"]) == len(meta)
+
+    def test_second_host_thread_gets_its_own_named_row(self):
+        rec = monitor.TraceRecorder()
+        with rec.span("main_work"):
+            t = threading.Thread(target=lambda: rec.begin("io_work"))
+            t.start()
+            t.join()
+        tids = {e["tid"] for e in rec.events() if e["ph"] == "B"}
+        assert tids == {0, 1}
+        names = {e["tid"]: e["args"]["name"] for e in rec.events()
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert names == {0: "host-thread-0", 1: "host-thread-1"}
+
+    def test_export_is_deterministic_and_ordered(self, tmp_path):
+        rec = self._cross_rank_trace()
+        p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+        rec.export(str(p1))
+        rec.export(str(p2))
+        assert p1.read_bytes() == p2.read_bytes()
+        events = json.loads(p1.read_text())["traceEvents"]
+        # all metadata rows first, sorted by (pid, tid, name) so Perfetto
+        # assigns rows identically on every load ...
+        n_meta = sum(1 for e in events if e["ph"] == "M")
+        assert all(e["ph"] == "M" for e in events[:n_meta])
+        assert all(e["ph"] != "M" for e in events[n_meta:])
+        meta_keys = [(e["pid"], e["tid"], e["name"]) for e in events[:n_meta]]
+        assert meta_keys == sorted(meta_keys)
+        # ... then timed events in nondecreasing timestamp order
+        ts = [e["ts"] for e in events[n_meta:]]
+        assert ts == sorted(ts)
+
+    def test_exported_cross_rank_trace_round_trips_to_analyzers(self, tmp_path):
+        """The exported JSON is the overlap/straggler engines' input format:
+        nesting stays valid per rank and the spans reconstruct exactly."""
+        rec = self._cross_rank_trace()
+        path = tmp_path / "trace.json"
+        rec.export(str(path))
+        events = json.loads(path.read_text())["traceEvents"]
+        _check_nesting(events)
+        ivs = monitor.span_intervals(events)
+        by_rank = {}
+        for iv in ivs:
+            by_rank.setdefault(iv["pid"], []).append(iv["name"])
+        assert sorted(by_rank[0]) == ["fwd", "step"]
+        assert sorted(by_rank[1]) == ["psum:ddp.grads", "step"]
+        # rank 0's fwd nests inside its step; rank 1's stack is independent
+        depths = {(iv["pid"], iv["name"]): iv["depth"] for iv in ivs}
+        assert depths[(0, "fwd")] == 1
+        assert depths[(0, "step")] == 0
+        assert depths[(1, "step")] == 0
+        assert depths[(1, "psum:ddp.grads")] == 1
+        rows = monitor.straggler_report(events)
+        assert [r["name"] for r in rows] == ["step"]
+        assert rows[0]["ranks"] == 2
 
 
 # -------------------------------------------------------------------------------
