@@ -209,15 +209,23 @@ class StepGuard:
         verdict: Dict[str, jax.Array],
         *,
         grad_scale=1.0,
+        extra_found_inf=None,
         **opt_kw,
     ):
         """One guarded optimizer step. Returns (params, opt_state, gstate).
 
+        ``extra_found_inf`` folds an externally-detected overflow into the
+        skip verdict — the optimizer-in-backward path's per-bucket flags
+        (``overlap.fold_found_inf`` of ``step_in_backward``) land here, so a
+        single overflowing bucket skips the WHOLE step (params, moments,
+        counter) and shrinks the loss scale exactly like a phased-path
+        overflow would.
+
         Order of operations (all device-side selects):
 
-        1. optimizer step with ``found_inf = grad_overflow | loss_nonfinite``
-           — the fused kernels' identity-select skip (moments and step counter
-           hold, apex/amp/handle.py:127-154);
+        1. optimizer step with ``found_inf = grad_overflow | loss_nonfinite
+           | extra_found_inf`` — the fused kernels' identity-select skip
+           (moments and step counter hold, apex/amp/handle.py:127-154);
         2. param sentinel (``check_params``): non-finite updated params revert
            params AND optimizer state to their pre-step values;
         3. scale update with the TOTAL skip — so a param-sentinel trip also
@@ -229,6 +237,8 @@ class StepGuard:
            snapshot := new params.
         """
         pre_inf = verdict["grad_overflow"] | verdict["loss_nonfinite"]
+        if extra_found_inf is not None:
+            pre_inf = pre_inf | (jnp.asarray(extra_found_inf) != 0)
         new_params, new_opt_state = opt.step(
             params, grads, opt_state,
             found_inf=pre_inf, grad_scale=grad_scale, **opt_kw,

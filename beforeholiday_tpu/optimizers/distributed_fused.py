@@ -67,12 +67,14 @@ class _DistributedFused:
         bucket_bytes: Optional[int] = None,
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
+        overlap_backward: bool = False,
     ):
         self.axis_name = axis_name
         self.grad_average = grad_average
         self.bucket_bytes = bucket_bytes
         self.compress = compress
         self.wire_dtype = wire_dtype
+        self.overlap_backward = overlap_backward
 
     def _world(self):
         return bucketing.static_axis_size(self.axis_name)
@@ -110,7 +112,7 @@ class _DistributedFused:
             state[key] = jnp.zeros((shard,), jnp.float32)
         return state
 
-    def _reduce_scatter_grads(self, grads, spec, shard):
+    def _reduce_scatter_grads(self, grads, spec, shard, *, concat=True):
         if isinstance(grads, PackedParams):
             lay = grads.layout
             if len(grads.arenas) == 1 and lay.specs[0].shapes == spec.shapes:
@@ -126,6 +128,19 @@ class _DistributedFused:
             gleaves = jax.tree_util.tree_leaves(grads)
             gflat, _ = flatten(gleaves, dtype=jnp.float32)
         gflat = _pad_to(gflat, shard * self._world())
+        if not concat:
+            # overlap path: keep the per-bucket pieces separate so each
+            # bucket's consumer (its slice of the fused update) can start
+            # the moment that bucket's reduce-scatter lands — the geometry
+            # is bucket_slices(shard, 4 * world, bucket_bytes), fp32 arena
+            chunks = bucketing.bucketed_psum_scatter(
+                gflat, self.axis_name, site="zero2.reduce_scatter_grads",
+                bucket_bytes=self.bucket_bytes, compress=self.compress,
+                wire_dtype=self.wire_dtype, concat=False,
+            )
+            if self.grad_average:
+                chunks = [c / self._world() for c in chunks]
+            return chunks
         g_shard = bucketing.bucketed_psum_scatter(
             gflat, self.axis_name, site="zero2.reduce_scatter_grads",
             bucket_bytes=self.bucket_bytes, compress=self.compress,
@@ -222,12 +237,13 @@ class DistributedFusedAdam(_DistributedFused):
         bucket_bytes: Optional[int] = None,
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
+        overlap_backward: bool = False,
         impl: Optional[str] = None,
     ):
         super().__init__(
             axis_name=axis_name, grad_average=grad_average,
             bucket_bytes=bucket_bytes, compress=compress,
-            wire_dtype=wire_dtype,
+            wire_dtype=wire_dtype, overlap_backward=overlap_backward,
         )
         self.lr, self.betas, self.eps = lr, betas, eps
         self.adam_w_mode = adam_w_mode
@@ -241,6 +257,11 @@ class DistributedFusedAdam(_DistributedFused):
     def step(self, params, grads, state, *, found_inf=None, grad_scale=1.0, lr=None):
         lr = self.lr if lr is None else lr
         leaves, treedef, spec, shard = self._arena_layout(params)
+        if self.overlap_backward:
+            return self._step_overlap(
+                params, grads, state, spec=spec, shard=shard,
+                found_inf=found_inf, grad_scale=grad_scale, lr=lr,
+            )
         g_shard = self._reduce_scatter_grads(grads, spec, shard) * grad_scale
         flag = self._global_found_inf(g_shard, found_inf)
         step_no = jnp.where(flag, state["step"], state["step"] + 1)
@@ -255,6 +276,59 @@ class DistributedFusedAdam(_DistributedFused):
         new_params = self._gather_params(p2, params, spec)
         return new_params, {
             "master": p2, "exp_avg": m2, "exp_avg_sq": v2, "step": step_no,
+        }
+
+    def _step_overlap(self, params, grads, state, *, spec, shard,
+                      found_inf, grad_scale, lr):
+        """Reduce-scatter-then-update PER BUCKET (the overlap_backward rung).
+
+        Each ~bucket_bytes column of the grad arena goes out as its own
+        reduce-scatter, and the fused Adam kernel consumes the matching
+        slice of the master/moment shards as a separate multi-tensor entry —
+        so bucket k's update math is dataflow-ready the moment bucket k's
+        collective lands, while later buckets are still on the wire (ref:
+        distributed_fused_adam.py:302 pipelined streams). Bitwise-identical
+        to the phased step: the kernel is elementwise over the arena, so
+        slicing commutes with it, and the overflow flag is the same global
+        any-bucket OR the phased path computes — one overflowing bucket
+        still skips the whole step on every rank."""
+        chunks = self._reduce_scatter_grads(grads, spec, shard, concat=False)
+        chunks = [c * grad_scale for c in chunks]
+        local_bad = jnp.zeros((), jnp.bool_)
+        for c in chunks:
+            # per-bucket flag, available as each bucket lands; the fold to
+            # ONE pmax'd scalar preserves whole-step skip semantics
+            local_bad = local_bad | jnp.any(~jnp.isfinite(c))
+        if found_inf is not None:
+            local_bad = local_bad | (jnp.asarray(found_inf) != 0)
+        flag = comms.pmax(local_bad.astype(jnp.float32), self.axis_name,
+                          site="zero2.found_inf") != 0
+        step_no = jnp.where(flag, state["step"], state["step"] + 1)
+
+        # state slices share the grad chunks' geometry: the fp32 (shard,)
+        # arena bucketed by wire cost (itemsize * world per column)
+        slices = bucketing.bucket_slices(
+            shard, 4 * self._world(), self.bucket_bytes,
+        )
+        assert len(slices) == len(chunks)
+        masters = [bucketing._slice_flat(state["master"], o, n) for o, n in slices]
+        ms = [bucketing._slice_flat(state["exp_avg"], o, n) for o, n in slices]
+        vs = [bucketing._slice_flat(state["exp_avg_sq"], o, n) for o, n in slices]
+
+        p2, m2, v2 = mt.multi_tensor_adam(
+            chunks, masters, ms, vs,
+            lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            step=step_no, adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction, weight_decay=self.weight_decay,
+            found_inf=flag, impl=self.impl,
+        )
+        master2 = p2[0] if len(p2) == 1 else jnp.concatenate(p2)
+        exp_avg2 = m2[0] if len(m2) == 1 else jnp.concatenate(m2)
+        exp_avg_sq2 = v2[0] if len(v2) == 1 else jnp.concatenate(v2)
+        new_params = self._gather_params(master2, params, spec)
+        return new_params, {
+            "master": master2, "exp_avg": exp_avg2,
+            "exp_avg_sq": exp_avg_sq2, "step": step_no,
         }
 
 
@@ -283,8 +357,20 @@ class DistributedFusedLAMB(_DistributedFused):
         bucket_bytes: Optional[int] = None,
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
+        overlap_backward: bool = False,
         impl: Optional[str] = None,
     ):
+        if overlap_backward:
+            # LAMB's trust ratios need per-tensor norms over the WHOLE shard
+            # (segment-id partial sums + cross-shard psum) before any slice
+            # can update — per-bucket updates would commit a bucket before
+            # the global norms exist. Fail loudly instead of silently
+            # serializing.
+            raise NotImplementedError(
+                "DistributedFusedLAMB does not support overlap_backward: "
+                "the sharded-norm reduction is a whole-shard barrier; use "
+                "DistributedFusedAdam or the phased LAMB step"
+            )
         super().__init__(
             axis_name=axis_name, grad_average=grad_average,
             bucket_bytes=bucket_bytes, compress=compress,

@@ -188,6 +188,51 @@ class _FusedOptimizer:
             )
         return gleaves, spec
 
+    def step_in_backward(self, flat_params, grad_leaves, state, *, spec=None,
+                         found_inf=None, grad_scale=1.0, lr=None,
+                         bucket_bytes=None, model_copy_dtype=None, **kw):
+        """View-path step driven by backward-time-reduced grads, with the
+        per-bucket overflow fold (the optimizer-in-backward rung).
+
+        ``grad_leaves`` is the leaf list coming out of
+        ``parallel.overlap``-hooked autodiff: each leaf was already reduced
+        inside the backward, so the update is the only work left — no
+        post-backward reduction phase, no second pass over the params arena.
+        Per-bucket ``found_inf`` flags (``partition_leaves(bucket_bytes)``
+        geometry, matching the reduction) are folded into ONE global flag
+        ORed with the scaler's ``found_inf``; that single flag feeds every
+        per-leaf kernel and the step counter.
+
+        Whole-step skip proof: the folded flag is the same traced scalar at
+        every kernel call, each kernel's ``found_inf`` select returns the
+        ORIGINAL params and moments when set, and ``_next_step`` holds the
+        counter on the same flag — so a non-finite value in ANY bucket skips
+        the ENTIRE step (params, all moments, count), never a prefix. Only
+        the final cheap selects wait on the flag; the heavy per-bucket math
+        is dataflow-independent of it and keeps overlapping.
+
+        Returns ``(*step_flat_outputs, folded_found_inf)`` — feed the flag
+        to ``StepGuard.apply_update(extra_found_inf=...)`` (or fold it into
+        the scaler update yourself) so the loss-scale backoff sees bucket
+        overflows exactly like phased ones.
+        """
+        if type(self).step_flat is _FusedOptimizer.step_flat:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no flat-arena step; "
+                "step_in_backward needs the view path"
+            )
+        from beforeholiday_tpu.parallel import overlap as _overlap
+
+        gleaves = list(grad_leaves)
+        flags = _overlap.per_bucket_found_inf(gleaves, bucket_bytes=bucket_bytes)
+        flag = _overlap.fold_found_inf(flags, found_inf)
+        outs = self.step_flat(
+            flat_params, gleaves, state, spec=spec, found_inf=flag,
+            grad_scale=grad_scale, lr=lr, model_copy_dtype=model_copy_dtype,
+            **kw,
+        )
+        return (*outs, flag)
+
     def as_optax(self):
         """Adapter to an ``optax.GradientTransformation`` (fp32 use)."""
         import optax

@@ -6,7 +6,7 @@ apex/transformer/parallel_state.py:81-682). NCCL process groups become named axe
 `jax.sharding.Mesh`; bucketed allreduce becomes `lax.psum` over the ``data`` axis.
 """
 
-from beforeholiday_tpu.parallel import bucketing, parallel_state
+from beforeholiday_tpu.parallel import bucketing, overlap, parallel_state
 from beforeholiday_tpu.parallel.bucketing import (
     DEFAULT_BUCKET_BYTES,
     BucketedReduce,
@@ -15,6 +15,12 @@ from beforeholiday_tpu.parallel.distributed import (
     DistributedDataParallel,
     Reducer,
     reduce_gradients,
+)
+from beforeholiday_tpu.parallel.overlap import (
+    fold_found_inf,
+    hook_tree,
+    per_bucket_found_inf,
+    reduction_hook,
 )
 from beforeholiday_tpu.parallel.larc import LARC
 from beforeholiday_tpu.parallel.sync_batch_norm import (
@@ -37,11 +43,16 @@ from beforeholiday_tpu.parallel.parallel_state import (
 __all__ = [
     "parallel_state",
     "bucketing",
+    "overlap",
     "BucketedReduce",
     "DEFAULT_BUCKET_BYTES",
     "DistributedDataParallel",
     "Reducer",
     "reduce_gradients",
+    "reduction_hook",
+    "hook_tree",
+    "per_bucket_found_inf",
+    "fold_found_inf",
     "LARC",
     "BatchNormParams",
     "BatchNormState",

@@ -231,6 +231,7 @@ def bucketed_psum_scatter(
     bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
     compress: bool = False,
     wire_dtype: Any = jnp.bfloat16,
+    concat: bool = True,
 ):
     """Reduce-scatter a (world*shard,) arena into this rank's (shard,) piece.
 
@@ -240,7 +241,13 @@ def bucketed_psum_scatter(
     contiguous (what the ZeRO-2 optimizer step indexes into). Compressed
     buckets do the all_to_all + local-fp32-sum exchange and never leave fp32
     on the reduction path (output cast back to the input dtype, a no-op for
-    fp32 arenas)."""
+    fp32 arenas).
+
+    ``concat=False`` returns the per-bucket pieces as a list (in shard
+    order, geometry ``bucket_slices(shard, itemsize * world, bucket_bytes)``)
+    instead of concatenating — the optimizer-in-backward path consumes each
+    bucket as it lands, and the concat at the end of *its* consumers would
+    otherwise serialize every bucket behind the slowest one."""
     world = static_axis_size(axis_name)
     total = flat.shape[0]
     if flat.ndim != 1 or total % world:
@@ -249,9 +256,10 @@ def bucketed_psum_scatter(
             f"size, got shape {flat.shape} over world={world}"
         )
     if not compress and bucket_bytes is None:
-        return comms.psum_scatter(
+        whole = comms.psum_scatter(
             flat, axis_name, scatter_dimension=0, tiled=True, site=site
         )
+        return whole if concat else [whole]
     shard = total // world
     mat = flat.reshape(world, shard)
     # a shard column costs world*itemsize wire bytes, so budget per column
@@ -274,6 +282,8 @@ def bucketed_psum_scatter(
                 tiled=True, site=site,
             )
         pieces.append(piece)
+    if not concat:
+        return pieces
     return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
 
 

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.monitor.spans import span
 from beforeholiday_tpu.ops.arena import PackedParams
-from beforeholiday_tpu.parallel import bucketing
+from beforeholiday_tpu.parallel import bucketing, overlap
 from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
 
 
@@ -199,6 +199,16 @@ class Reducer:
         self.compress = compress
         self.wire_dtype = wire_dtype
 
+    def hook(self, tree: Any, *, tag: str = "reducer") -> Any:
+        """Backward-time variant of :meth:`reduce`: identity on ``tree``
+        whose backward reduces the cotangent per top-level group, with this
+        reducer's bucketing knobs (see ``parallel.overlap.hook_tree``)."""
+        return overlap.hook_tree(
+            tree, tag=tag, axis_name=self.axis_name,
+            bucket_bytes=self.bucket_bytes, compress=self.compress,
+            wire_dtype=self.wire_dtype,
+        )
+
     def broadcast_params(self, params: Any) -> Any:
         """Make params exactly rank 0's values on every rank (ref:
         distributed.py:254 broadcasts rank 0 at init). Implemented as a masked
@@ -248,6 +258,7 @@ class DistributedDataParallel:
         bucket_bytes: Optional[int] = None,
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
+        overlap_backward: bool = False,
     ):
         self.axis_name = axis_name
         self.gradient_average = gradient_average
@@ -256,6 +267,7 @@ class DistributedDataParallel:
         self.bucket_bytes = bucket_bytes
         self.compress = compress
         self.wire_dtype = wire_dtype
+        self.overlap_backward = overlap_backward
 
     def reduce(self, grads: Any) -> Any:
         return reduce_gradients(
@@ -269,9 +281,32 @@ class DistributedDataParallel:
             wire_dtype=self.wire_dtype,
         )
 
+    def hook(self, tree: Any, *, tag: str = "ddp") -> Any:
+        """Backward-time reduction boundary with this DDP's knobs: identity
+        on ``tree``; its cotangent comes back reduced per top-level group,
+        launched inside the backward (the apex ``delay_allreduce=False``
+        hook path; see ``parallel.overlap``)."""
+        return overlap.hook_tree(
+            tree, tag=tag, axis_name=self.axis_name,
+            gradient_average=self.gradient_average,
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            allreduce_always_fp32=self.allreduce_always_fp32,
+            bucket_bytes=self.bucket_bytes, compress=self.compress,
+            wire_dtype=self.wire_dtype,
+        )
+
     def value_and_grad(
         self, loss_fn: Callable, *, has_aux: bool = False
     ) -> Callable:
+        if self.overlap_backward:
+            # hook the params at the loss boundary: autodiff then reduces
+            # each top-level group's cotangent inside the backward, so no
+            # post-backward sweep is needed (bitwise-equal uncompressed)
+            def hooked(params, *args, **kw):
+                return loss_fn(self.hook(params), *args, **kw)
+
+            return jax.value_and_grad(hooked, has_aux=has_aux)
+
         vag = jax.value_and_grad(loss_fn, has_aux=has_aux)
 
         def wrapped(params, *args, **kw):

@@ -18,11 +18,12 @@ from typing import Optional
 import jax
 
 from beforeholiday_tpu.monitor import comms
+from beforeholiday_tpu.parallel.bucketing import static_axis_size
 from beforeholiday_tpu.parallel.parallel_state import PIPE_AXIS
 
 
 def _ring(axis_name: str, shift: int):
-    n = jax.lax.axis_size(axis_name)
+    n = static_axis_size(axis_name)
     return [(i, (i + shift) % n) for i in range(n)]
 
 
@@ -61,3 +62,25 @@ def send_forward_recv_backward(y, dy, *, axis_name: str = PIPE_AXIS):
 def send_backward_recv_forward(dy, y, *, axis_name: str = PIPE_AXIS):
     out_y, out_dy = send_forward_recv_backward(y, dy, axis_name=axis_name)
     return out_dy, out_y
+
+
+def send_forward_recv_backward_double_buffered(
+    pending_y, pending_dy, *, axis_name: str = PIPE_AXIS
+):
+    """The 1F1B pair on the PREVIOUS tick's outputs — the double-buffered
+    p2p the overlap schedules run.
+
+    The classic tick sends the activation/cotangent it just computed, so the
+    permute's operands depend on the tick's compute and XLA must order ring
+    after math. Here the operands are registers holding tick ``t-1``'s
+    outputs: the permute at tick ``t`` is dataflow-independent of tick
+    ``t``'s stage compute, so the scheduler overlaps wire and math inside
+    every tick — the next microbatch's recv is in flight while the current
+    chunk computes. The price is one extra tick of latency per hop
+    (produce at ``t``, ride the ring at ``t+1``, consumable at ``t+2``),
+    which the table-driven overlap schedule absorbs as its recorded
+    ``phase_shift_ticks``. Same ops, same ledger sites ("pp.fwd_ring" /
+    "pp.bwd_ring") — attribution and byte oracles are unchanged."""
+    return send_forward_recv_backward(
+        pending_y, pending_dy, axis_name=axis_name
+    )

@@ -49,9 +49,11 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.monitor.spans import span
+from beforeholiday_tpu.parallel import bucketing
 from beforeholiday_tpu.parallel.parallel_state import PIPE_AXIS
 from beforeholiday_tpu.remat import apply as _remat_apply
 from beforeholiday_tpu.transformer.pipeline_parallel import p2p_communication
@@ -473,6 +475,421 @@ def _pipelined_fwd_bwd(
     return loss, g_stage, g_embed, g_head
 
 
+# --- double-buffered (overlap_p2p) engine -----------------------------------
+#
+# The classic engine's ring at tick t sends the activation/cotangent computed
+# AT tick t, so XLA must finish the tick's math before the permute can issue.
+# The overlap engine sends tick t-1's outputs instead (registers), making the
+# permute dataflow-independent of the tick's compute — wire and math overlap
+# inside every tick. A hop therefore takes TWO ticks (produce at t, ride the
+# ring at t+1, consumable at t+2), which breaks the closed-form tick
+# equations: for V>1 the distance-2 recurrences collide (two chunks of one
+# device would need the same tick). So the schedule is built on the HOST by a
+# greedy list scheduler over the event DAG and shipped to the device as
+# static (S, T) lookup tables — same cond-gated slot machinery as the classic
+# engine, just table-indexed instead of formula-decoded. Received values land
+# in small ring buffers (depth = max produce→consume distance, computed from
+# the realized schedule) because a tick's recv can no longer be consumed the
+# next tick in general.
+
+
+@functools.lru_cache(maxsize=None)
+def _overlap_tables(M: int, S: int, V: int) -> Dict[str, Any]:
+    """Greedy list schedule of the distance-2 pipeline event DAG.
+
+    Events F(m, l) / B(m, l) for logical stage ``l = v*S + s`` in [0, V*S);
+    device ``l % S``. Ready rules (ticks):
+
+    * F(m, 0) is always ready; F(m, l) at ``t >= t_F(m, l-1) + 2`` (hop =
+      produce + ring + consume);
+    * B(m, L-1) at ``t >= t_F(m, L-1) + 1`` (same device, via the act
+      store); B(m, l) at ``t >= t_B(m, l+1) + 2``.
+
+    Each device runs at most one F and one B per tick; ties break by the
+    classic schedule's issue order (F: ``g*V*S + v*S + r``, B:
+    ``g*V*S + (V-1-v)*S + r`` with ``g, r = divmod(m, S)``), so at V=1 the
+    greedy solution reproduces the closed forms ``t_F = m + 2s``,
+    ``t_B = 2S-1 + m + 2(S-1-s)`` and ``T = M + 4S - 3`` — a phase shift of
+    ``2(S-1)`` ticks over the classic ``M + 2S - 1``.
+
+    Returns numpy tables indexed ``[device, tick]`` (F_valid/F_m/F_v/F_src/
+    F_first, B_valid/B_m/B_v/B_act/B_src/B_last/B_first), the ring-buffer
+    depths (``r_act``, ``r_f``, ``r_b``), and ``total_ticks``. Pure host
+    integer arithmetic, cached per static (M, S, V).
+    """
+    L = V * S
+    t_F: Dict[Tuple[int, int], int] = {}
+    t_B: Dict[Tuple[int, int], int] = {}
+    rows_f: List[List[Optional[Tuple[int, int]]]] = []
+    rows_b: List[List[Optional[Tuple[int, int]]]] = []
+    n_events = 2 * M * L
+    done = 0
+    cap = 4 * (M * V + V * S + S - 1) + 4 * L + 64
+    t = 0
+    while done < n_events:
+        if t > cap:
+            raise RuntimeError(
+                f"_overlap_tables(M={M}, S={S}, V={V}) failed to converge "
+                f"within {cap} ticks — scheduler bug"
+            )
+        fr: List[Optional[Tuple[int, int]]] = [None] * S
+        br: List[Optional[Tuple[int, int]]] = [None] * S
+        for s in range(S):
+            best_f = None
+            best_b = None
+            for v in range(V):
+                l = v * S + s
+                for m in range(M):
+                    g, r = divmod(m, S)
+                    if (m, l) not in t_F:
+                        key = g * V * S + v * S + r
+                        # t >= key throttles run-ahead (F(m, 0) is always
+                        # data-ready): never issue before the classic
+                        # schedule would, keeping in-flight microbatches —
+                        # and hence the realized ring depths — O(V*S)
+                        # instead of O(M)
+                        ready = t >= key and (
+                            l == 0
+                            or (
+                                (m, l - 1) in t_F
+                                and t >= t_F[(m, l - 1)] + 2
+                            )
+                        )
+                        if ready and (best_f is None or key < best_f[0]):
+                            best_f = (key, m, l)
+                    if (m, l) not in t_B:
+                        if l == L - 1:
+                            ready = (m, l) in t_F and t >= t_F[(m, l)] + 1
+                        else:
+                            ready = (
+                                (m, l + 1) in t_B
+                                and t >= t_B[(m, l + 1)] + 2
+                            )
+                        if ready:
+                            key = g * V * S + (V - 1 - l // S) * S + r
+                            if best_b is None or key < best_b[0]:
+                                best_b = (key, m, l)
+            if best_f is not None:
+                _, m, l = best_f
+                t_F[(m, l)] = t
+                fr[s] = (m, l)
+                done += 1
+            if best_b is not None:
+                _, m, l = best_b
+                t_B[(m, l)] = t
+                br[s] = (m, l)
+                done += 1
+        rows_f.append(fr)
+        rows_b.append(br)
+        t += 1
+    T = t
+
+    # ring-buffer depths from the REALIZED schedule: a value written at tick
+    # w is clobbered by the write at w + depth, so depth must exceed every
+    # produce→consume gap (act store: F write and B read share the tick's
+    # compute phase, so the consume tick itself must stay below w + depth)
+    r_act = max(t_B[k] - t_F[k] for k in t_F) + 1
+    r_f = max(
+        [t_F[(m, l)] - (t_F[(m, l - 1)] + 1)
+         for (m, l) in t_F if l > 0] or [1]
+    )
+    r_b = max(
+        [t_B[(m, l)] - (t_B[(m, l + 1)] + 1)
+         for (m, l) in t_B if l < L - 1] or [1]
+    )
+    r_f = max(r_f, 1)
+    r_b = max(r_b, 1)
+
+    def _blank():
+        return (np.zeros((S, T), np.bool_), np.zeros((S, T), np.int32),
+                np.zeros((S, T), np.int32), np.zeros((S, T), np.int32),
+                np.zeros((S, T), np.bool_))
+
+    F_valid, F_m, F_v, F_src, F_first = _blank()
+    B_valid, B_m, B_v, B_src, B_first = _blank()
+    B_act = np.zeros((S, T), np.int32)
+    B_last = np.zeros((S, T), np.bool_)
+    for tt, fr in enumerate(rows_f):
+        for s, ev in enumerate(fr):
+            if ev is None:
+                continue
+            m, l = ev
+            F_valid[s, tt] = True
+            F_m[s, tt] = m
+            F_v[s, tt] = l // S
+            F_first[s, tt] = l == 0
+            if l > 0:
+                F_src[s, tt] = (t_F[(m, l - 1)] + 1) % r_f
+    for tt, br_row in enumerate(rows_b):
+        for s, ev in enumerate(br_row):
+            if ev is None:
+                continue
+            m, l = ev
+            B_valid[s, tt] = True
+            B_m[s, tt] = m
+            B_v[s, tt] = l // S
+            B_first[s, tt] = l == 0
+            B_last[s, tt] = l == L - 1
+            B_act[s, tt] = t_F[(m, l)] % r_act
+            if l < L - 1:
+                B_src[s, tt] = (t_B[(m, l + 1)] + 1) % r_b
+    return {
+        "total_ticks": T,
+        "r_act": r_act,
+        "r_f": r_f,
+        "r_b": r_b,
+        "t_F": dict(t_F),
+        "t_B": dict(t_B),
+        "F_valid": F_valid, "F_m": F_m, "F_v": F_v, "F_src": F_src,
+        "F_first": F_first,
+        "B_valid": B_valid, "B_m": B_m, "B_v": B_v, "B_src": B_src,
+        "B_act": B_act, "B_first": B_first, "B_last": B_last,
+    }
+
+
+def _pipelined_fwd_bwd_overlap(
+    stage_fn, loss_fn, chunk_params, inputs, targets, *, V, axis_name,
+    embed_fn=None, embed_params=None, head_fn=None, head_params=None,
+):
+    """Table-driven double-buffered engine (see the overlap_p2p note above).
+
+    Mirrors ``_pipelined_fwd_bwd`` slot for slot — same cond-gating, same
+    branch-divergence rules, same loss/grad accumulation, same final psums —
+    with three changes: slots come from ``_overlap_tables`` instead of the
+    closed-form decompositions, the rings carry the PREVIOUS tick's outputs
+    (``p2p_communication.send_forward_recv_backward_double_buffered``), and
+    received values land in depth-``r_f``/``r_b`` ring buffers read at
+    table-given slots. Uncompressed parity with the sequential reference is
+    pinned by the overlap_engine tests. Keep in sync with the classic engine
+    when touching either.
+    """
+    S = bucketing.static_axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = inputs.shape[0]
+    if targets.shape[0] != M:
+        raise ValueError(
+            f"microbatch-count mismatch: inputs has {M} microbatches but "
+            f"targets has {targets.shape[0]}; both must agree"
+        )
+    if V > 1 and M % S != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) divisible by "
+            f"pipeline size ({S}), as the reference asserts"
+        )
+    tab = _overlap_tables(M, S, V)
+    total_ticks = tab["total_ticks"]
+    classic_ticks = M * V + V * S + S - 1
+    _record_schedule(schedule_report(
+        M, S, virtual_size=V,
+        schedule="interleaved_1f1b" if V > 1 else "1f1b",
+        extra={
+            "p2p_overlap": True,
+            "overlap_total_ticks": total_ticks,
+            "phase_shift_ticks": total_ticks - classic_ticks,
+        },
+    ))
+    r_act, r_f, r_b = tab["r_act"], tab["r_f"], tab["r_b"]
+    F_valid = jnp.asarray(tab["F_valid"])
+    F_m = jnp.asarray(tab["F_m"])
+    F_v = jnp.asarray(tab["F_v"])
+    F_src = jnp.asarray(tab["F_src"])
+    F_first = jnp.asarray(tab["F_first"])
+    B_valid = jnp.asarray(tab["B_valid"])
+    B_m = jnp.asarray(tab["B_m"])
+    B_v = jnp.asarray(tab["B_v"])
+    B_src = jnp.asarray(tab["B_src"])
+    B_act = jnp.asarray(tab["B_act"])
+    B_first = jnp.asarray(tab["B_first"])
+    B_last = jnp.asarray(tab["B_last"])
+
+    def chunk_of(v):
+        return jax.tree.map(lambda leaf: leaf[v], chunk_params)
+
+    def run_embed(ep, raw):
+        return embed_fn(ep, raw) if embed_fn is not None else raw
+
+    def run_head(hp, h):
+        return head_fn(hp, h) if head_fn is not None else h
+
+    if embed_fn is not None:
+        hidden_aval = jax.eval_shape(run_embed, embed_params, inputs[0])
+        hidden_shape, hidden_dtype = hidden_aval.shape, hidden_aval.dtype
+    else:
+        hidden_shape, hidden_dtype = inputs.shape[1:], inputs.dtype
+
+    zeros_embed_g = (
+        jax.tree.map(jnp.zeros_like, embed_params) if embed_fn is not None else None
+    )
+    zeros_head_g = (
+        jax.tree.map(jnp.zeros_like, head_params) if head_fn is not None else None
+    )
+    zeros_stage_g = jax.tree.map(jnp.zeros_like, chunk_params)
+
+    def tick(t, carry):
+        (act_buf, fwd_buf, bwd_buf, pend_y, pend_dx,
+         g_stage, g_embed, g_head, loss_acc) = carry
+
+        # ---- forward slot (reads buffers as written through tick t-1) ----
+        with span("pp_forward_slot"):
+            f_valid = F_valid[rank, t]
+            m_f = F_m[rank, t]
+            v_f = F_v[rank, t]
+            src_f = F_src[rank, t]
+            first_f = F_first[rank, t]
+            sp_f = chunk_of(v_f)
+
+            def fwd_compute():
+                x_in = jax.lax.cond(
+                    first_f,
+                    lambda: run_embed(embed_params, inputs[m_f]).astype(
+                        hidden_dtype
+                    ),
+                    lambda: jax.lax.dynamic_index_in_dim(
+                        fwd_buf, src_f, 0, keepdims=False
+                    ).astype(hidden_dtype),
+                )
+                return x_in, stage_fn(sp_f, x_in).astype(hidden_dtype)
+
+            def fwd_idle():
+                z = jnp.zeros(hidden_shape, hidden_dtype)
+                return z, z
+
+            x_in, y = jax.lax.cond(f_valid, fwd_compute, fwd_idle)
+            act_buf = jnp.where(
+                f_valid,
+                jax.lax.dynamic_update_index_in_dim(
+                    act_buf, x_in, t % r_act, 0
+                ),
+                act_buf,
+            )
+
+        # ---- backward slot ----
+        b_valid = B_valid[rank, t]
+        m_b = B_m[rank, t]
+        v_b = B_v[rank, t]
+        sp_b = chunk_of(v_b)
+        x_saved = jax.lax.dynamic_index_in_dim(
+            act_buf, B_act[rank, t], 0, keepdims=False
+        )
+        ct_in = jax.lax.dynamic_index_in_dim(
+            bwd_buf, B_src[rank, t], 0, keepdims=False
+        )
+        last_b = B_last[rank, t]
+        first_b = B_first[rank, t]
+        tgt_b = targets[m_b]
+
+        def last_branch():
+            def full(sp, hp, x):
+                out = run_head(hp, stage_fn(sp, x))
+                return loss_fn(out, tgt_b) / M
+
+            if head_fn is not None:
+                mb_loss, (dsp, dhp, dx) = jax.value_and_grad(
+                    full, argnums=(0, 1, 2)
+                )(sp_b, head_params, x_saved)
+                return mb_loss.astype(jnp.float32), dsp, dhp, dx
+            mb_loss, (dsp, dx) = jax.value_and_grad(
+                lambda sp, x: full(sp, None, x), argnums=(0, 1)
+            )(sp_b, x_saved)
+            return mb_loss.astype(jnp.float32), dsp, zeros_head_g, dx
+
+        def inner_branch():
+            _, vjp = jax.vjp(lambda sp, x: stage_fn(sp, x), sp_b, x_saved)
+            dsp, dx = vjp(ct_in.astype(hidden_dtype))
+            return jnp.float32(0.0), dsp, zeros_head_g, dx
+
+        def idle_branch():
+            return (
+                jnp.float32(0.0),
+                jax.tree.map(jnp.zeros_like, sp_b),
+                zeros_head_g,
+                jnp.zeros(hidden_shape, hidden_dtype),
+            )
+
+        with span("pp_backward_slot"):
+            mb_loss, dsp, dhp, dx = jax.lax.cond(
+                b_valid,
+                lambda: jax.lax.cond(last_b, last_branch, inner_branch),
+                idle_branch,
+            )
+
+        loss_acc = loss_acc + jnp.where(b_valid & last_b, mb_loss, 0.0)
+        g_stage = jax.tree.map(
+            lambda acc, d: jnp.where(
+                b_valid,
+                jax.lax.dynamic_update_index_in_dim(
+                    acc, acc[v_b] + d.astype(acc.dtype), v_b, 0
+                ),
+                acc,
+            ),
+            g_stage,
+            dsp,
+        )
+        if head_fn is not None:
+            g_head = _acc_tree(g_head, b_valid & last_b, dhp)
+        if embed_fn is not None:
+            def embed_grad():
+                _, vjp_e = jax.vjp(
+                    lambda ep: run_embed(ep, inputs[m_b]), embed_params
+                )
+                (dep,) = vjp_e(dx.astype(hidden_dtype))
+                return dep
+
+            dep = jax.lax.cond(
+                b_valid & first_b, embed_grad, lambda: zeros_embed_g
+            )
+            g_embed = _acc_tree(g_embed, b_valid & first_b, dep)
+
+        # ---- rings: PREVIOUS tick's outputs, independent of this tick's
+        # compute — recvs land in the ring buffers for table-given consumers
+        with span("pp_p2p_rings"):
+            recv_y, recv_dx = (
+                p2p_communication.send_forward_recv_backward_double_buffered(
+                    pend_y, pend_dx, axis_name=axis_name
+                )
+            )
+        fwd_buf = jax.lax.dynamic_update_index_in_dim(
+            fwd_buf, recv_y, t % r_f, 0
+        )
+        bwd_buf = jax.lax.dynamic_update_index_in_dim(
+            bwd_buf, recv_dx, t % r_b, 0
+        )
+        pend_y = jnp.where(f_valid, y, 0.0).astype(hidden_dtype)
+        pend_dx = jnp.where(b_valid, dx, 0.0).astype(hidden_dtype)
+        return (act_buf, fwd_buf, bwd_buf, pend_y, pend_dx,
+                g_stage, g_embed, g_head, loss_acc)
+
+    zeros_h = jnp.zeros(hidden_shape, hidden_dtype)
+    carry0 = (
+        jnp.zeros((r_act,) + hidden_shape, hidden_dtype),
+        jnp.zeros((r_f,) + hidden_shape, hidden_dtype),
+        jnp.zeros((r_b,) + hidden_shape, hidden_dtype),
+        zeros_h,
+        zeros_h,
+        zeros_stage_g,
+        zeros_embed_g,
+        zeros_head_g,
+        jnp.float32(0.0),
+    )
+    (_, _, _, _, _, g_stage, g_embed, g_head, loss) = jax.lax.fori_loop(
+        0, total_ticks, tick, carry0
+    )
+    loss = comms.psum(loss, axis_name, site="pp.loss_allreduce")
+    if embed_fn is not None:
+        g_embed = jax.tree.map(
+            lambda g: comms.psum(g, axis_name,
+                                 site="pp.embed_head_allreduce"),
+            g_embed,
+        )
+    if head_fn is not None:
+        g_head = jax.tree.map(
+            lambda g: comms.psum(g, axis_name,
+                                 site="pp.embed_head_allreduce"),
+            g_head,
+        )
+    return loss, g_stage, g_embed, g_head
+
+
 def forward_backward_pipelining_without_interleaving(
     stage_fn: Callable,
     loss_fn: Callable,
@@ -486,6 +903,7 @@ def forward_backward_pipelining_without_interleaving(
     head_fn: Optional[Callable] = None,
     head_params: Any = None,
     remat_policy: Optional[str] = None,
+    overlap_p2p: bool = False,
 ):
     """1F1B schedule (ref: fwd_bwd_pipelining_without_interleaving.py:228-488).
 
@@ -502,10 +920,17 @@ def forward_backward_pipelining_without_interleaving(
     the warmup phase holds up to S in-flight microbatches of stage residuals,
     and checkpointing the stage shrinks each held set to its boundary saves
     (ref: apex/transformer checkpointed layers).
+
+    ``overlap_p2p=True`` selects the double-buffered engine: rings carry the
+    previous tick's outputs so the permutes are dataflow-independent of each
+    tick's compute and XLA overlaps wire with math; the schedule stretches by
+    the recorded ``phase_shift_ticks`` (``2*(S-1)`` at V=1). Numerics are
+    identical — same ops, same accumulation order.
     """
     stage_fn = _remat_apply(stage_fn, remat_policy)
     chunked = jax.tree.map(lambda leaf: leaf[None], params)
-    loss, g_stage, g_embed, g_head = _pipelined_fwd_bwd(
+    engine = _pipelined_fwd_bwd_overlap if overlap_p2p else _pipelined_fwd_bwd
+    loss, g_stage, g_embed, g_head = engine(
         stage_fn, loss_fn, chunked, inputs, targets, V=1, axis_name=axis_name,
         embed_fn=embed_fn, embed_params=embed_params,
         head_fn=head_fn, head_params=head_params,
@@ -829,6 +1254,7 @@ def forward_backward_pipelining_with_interleaving(
     head_fn: Optional[Callable] = None,
     head_params: Any = None,
     remat_policy: Optional[str] = None,
+    overlap_p2p: bool = False,
 ):
     """Interleaved virtual-pipeline schedule
     (ref: fwd_bwd_pipelining_with_interleaving.py:26-415).
@@ -839,13 +1265,17 @@ def forward_backward_pipelining_with_interleaving(
     reference's assert). Returns ``(loss, grads)`` with grads leading with V
     (or ``PipelineGrads`` when embed/head are given). ``remat_policy``:
     named remat policy applied per stage chunk (see the 1F1B docstring).
+    ``overlap_p2p``: double-buffered table-driven engine (see the 1F1B
+    docstring); for V>1 the schedule comes from the greedy list scheduler
+    since the distance-2 recurrences have no closed form.
     """
     stage_fn = _remat_apply(stage_fn, remat_policy)
     V = virtual_pipeline_model_parallel_size
     bad = [leaf.shape for leaf in jax.tree.leaves(chunk_params) if leaf.shape[0] != V]
     if bad:
         raise ValueError(f"chunk_params leaves must lead with V={V}, got {bad[0]}")
-    loss, g_stage, g_embed, g_head = _pipelined_fwd_bwd(
+    engine = _pipelined_fwd_bwd_overlap if overlap_p2p else _pipelined_fwd_bwd
+    loss, g_stage, g_embed, g_head = engine(
         stage_fn, loss_fn, chunk_params, inputs, targets, V=V, axis_name=axis_name,
         embed_fn=embed_fn, embed_params=embed_params,
         head_fn=head_fn, head_params=head_params,

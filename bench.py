@@ -914,6 +914,34 @@ def bench_overlap_skew():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_overlap_engine():
+    """Overlap-engine paired rungs on the same virtual 8-CPU mesh subprocess
+    — a PROGRAM-POSITION PROXY: the child traces each paired variant to a
+    jaxpr and replays it through a deterministic dual-engine cost model, so
+    the gated ratios measure where the collectives sit in the program, not
+    wall clock. The child pins numerics first (hook bitwise vs post-backward,
+    compressed within the analytic bound) and asserts the hook variant's
+    replayed overlap_fraction is strictly higher before printing. Same env
+    scrub as ``bench_pp_overhead``."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.overlap_engine_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"overlap_engine_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------------
@@ -1195,6 +1223,26 @@ def main():
             "rank_skew vs numpy) before printing"
         )
         pass2.update(ov.get("pass2") or {})
+
+    # --- overlap-engine replay rungs (CPU proxy, subprocess) ---
+    oe = _stage(detail, bench_overlap_engine)
+    if oe:
+        for k in ("ddp_overlap_vs_post_backward", "opt_in_backward_vs_phased",
+                  "ddp_hook_overlap_fraction", "ddp_post_overlap_fraction",
+                  "opt_hook_overlap_fraction", "opt_phased_overlap_fraction"):
+            detail[k] = oe.get(k)
+        detail["overlap_engine_bench"] = {
+            k: v for k, v in oe.items()
+            if k not in ("pass2", "compile_counters")
+        }
+        detail["overlap_engine_note"] = (
+            "deterministic jaxpr-replay proxy on an 8-CPU mesh: ratios gate "
+            "collective ISSUE POSITION (backward-time vs post-backward), "
+            "numerics pinned bitwise / within compression_error_bound in the "
+            "child; the overlap claim is the strict fraction inequality the "
+            "child asserts, wall clock means nothing on this host"
+        )
+        pass2.update(oe.get("pass2") or {})
 
     # --- guard dispatch + comms + compile counters: what every rung above
     # actually dispatched/communicated/compiled (collected LAST so the

@@ -37,6 +37,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax >= 0.6 spells manual mode jax.shard_map(check_vma=False); older jax has
+# the experimental module with check_rep — accept either
+if hasattr(jax, "shard_map"):
+    _shard_map = functools.partial(jax.shard_map, check_vma=False)
+else:
+    from jax.experimental.shard_map import shard_map as _esm
+
+    _shard_map = functools.partial(_esm, check_rep=False)
+
 from beforeholiday_tpu import amp
 from beforeholiday_tpu.models import resnet
 from beforeholiday_tpu.optimizers import FusedSGD
@@ -120,6 +129,9 @@ def build_trainer(
     seed: int = 0,
     cfg: Optional[resnet.ResNetConfig] = None,
     fused_optimizer: Optional[Any] = None,
+    bucket_bytes: Optional[int] = None,
+    compress: bool = False,
+    overlap_backward: bool = False,
 ) -> Trainer:
     """Assemble model + amp + optimizer + (optionally) the data-parallel mesh.
 
@@ -179,7 +191,15 @@ def build_trainer(
     optimizer = amp_model.optimizer
     scaler = amp_model.scaler
 
-    ddp = DistributedDataParallel() if distributed else None
+    ddp = (
+        DistributedDataParallel(
+            bucket_bytes=bucket_bytes,
+            compress=compress,
+            overlap_backward=overlap_backward,
+        )
+        if distributed
+        else None
+    )
 
     def normalize(images):
         # the prefetcher's sub_(mean).div_(std) fused into the step
@@ -189,12 +209,21 @@ def build_trainer(
         x = normalize(images)
 
         def loss_fn(p):
+            if ddp is not None and ddp.overlap_backward:
+                # backward-time reduction: hooked boundary makes each param
+                # group's grad psum issue inside the backward itself (apex
+                # delay_allreduce=False), so no post-backward sweep is needed
+                p = ddp.hook(p)
             logits, new_bn = amp_model.apply(p, bn_state, x)
             return softmax_cross_entropy(logits, labels), (new_bn, logits)
 
         svag = amp.scaled_value_and_grad(
             loss_fn, scaler, has_aux=True,
-            reduce_grads=ddp.reduce if ddp is not None else None,
+            reduce_grads=(
+                ddp.reduce
+                if ddp is not None and not ddp.overlap_backward
+                else None
+            ),
         )
         loss, (new_bn, logits), grads, found_inf, new_scaler_state = svag(
             params, scaler_state
@@ -230,16 +259,15 @@ def build_trainer(
     _donate = (0, 1, 2, 3)
     if distributed:
         rep = P()
-        train_step = donate_step(jax.shard_map(
+        train_step = donate_step(_shard_map(
             core_step, mesh=mesh,
             in_specs=(rep, rep, rep, rep, P("data"), P("data"), rep),
             out_specs=(rep, rep, rep, rep, rep),
-            check_vma=False,
         ), donate_argnums=_donate)
-        eval_step = jax.jit(jax.shard_map(
+        eval_step = jax.jit(_shard_map(
             core_eval, mesh=mesh,
             in_specs=(rep, rep, P("data"), P("data")),
-            out_specs=rep, check_vma=False,
+            out_specs=rep,
         ))
     else:
         train_step = donate_step(core_step, donate_argnums=_donate)
@@ -335,6 +363,16 @@ def parse_args(argv=None):
                    help="keep a ring buffer of recent step metrics and dump "
                         "it (with guard/comms/compile counters) to PATH on "
                         "crash or exit")
+    p.add_argument("--bucket-bytes", type=int, default=None,
+                   help="coalesce gradient all-reduces into buckets of this "
+                        "many bytes (apex allreduce_bucket_cap_mb)")
+    p.add_argument("--compress", action="store_true",
+                   help="all-reduce gradients in bf16 with fp32 accumulation")
+    p.add_argument("--overlap-backward", action="store_true",
+                   help="issue each bucket's all-reduce inside the backward "
+                        "pass as its grads are produced (apex "
+                        "delay_allreduce=False) instead of one post-backward "
+                        "sweep")
     return p.parse_args(argv)
 
 
@@ -350,6 +388,8 @@ def main(argv=None):
         use_larc=args.larc, global_batch=args.batch_size,
         num_classes=args.num_classes,
         seed=0 if args.deterministic else int(time.time()) % (2**31),
+        bucket_bytes=args.bucket_bytes, compress=args.compress,
+        overlap_backward=args.overlap_backward,
     )
     print(f"devices: {jax.device_count()}  distributed: {trainer.distributed}")
     from beforeholiday_tpu.utils.profiling import trace as profile_trace

@@ -15,8 +15,18 @@ Run (any machine — 8 virtual CPU devices stand in for a TPU slice):
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python distributed_data_parallel.py
+
+Knobs (mirroring the reference DDP's allreduce controls):
+
+* ``--bucket-bytes N`` — coalesce the gradient all-reduce into N-byte
+  buckets (apex ``allreduce_bucket_cap_mb``);
+* ``--compress`` — bf16 wire format with fp32 accumulation;
+* ``--overlap-backward`` — launch each group's all-reduce inside the
+  backward as its grads are produced (apex ``delay_allreduce=False``)
+  instead of one post-backward sweep.
 """
 
+import argparse
 import functools
 
 import jax
@@ -41,7 +51,21 @@ from beforeholiday_tpu.remat import donate_step
 N, D_in, D_out = 64, 1024, 16  # per-rank batch, like the reference's fake data
 
 
-def main():
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--bucket-bytes", type=int, default=None,
+                   help="coalesce gradient all-reduces into buckets of this "
+                        "many bytes")
+    p.add_argument("--compress", action="store_true",
+                   help="all-reduce gradients in bf16 with fp32 accumulation")
+    p.add_argument("--overlap-backward", action="store_true",
+                   help="reduce each group inside the backward pass instead "
+                        "of one post-backward sweep")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
     devices = np.asarray(jax.devices())
     world = len(devices)
     mesh = Mesh(devices, ("data",))
@@ -61,13 +85,24 @@ def main():
     model = amp.initialize(
         lambda p, x: x @ p["w"] + p["b"], params, FusedSGD(lr=1e-3), "O1"
     )
-    ddp = DistributedDataParallel()
+    ddp = DistributedDataParallel(
+        bucket_bytes=args.bucket_bytes,
+        compress=args.compress,
+        overlap_backward=args.overlap_backward,
+    )
 
     def loss_fn(p, x, y):
+        if ddp.overlap_backward:
+            # hooked boundary: each group's grad psum issues inside the
+            # backward itself, so no post-backward reduce_grads sweep
+            p = ddp.hook(p)
         pred = model.apply(p, x)
         return jnp.mean((pred - y) ** 2)
 
-    svag = amp.scaled_value_and_grad(loss_fn, model.scaler, reduce_grads=ddp.reduce)
+    svag = amp.scaled_value_and_grad(
+        loss_fn, model.scaler,
+        reduce_grads=None if ddp.overlap_backward else ddp.reduce,
+    )
 
     # (state, scaler_state) donated: the loop rebinds both every step, so XLA
     # updates params/opt/scaler storage in place instead of double-buffering

@@ -194,6 +194,21 @@ def test_bucketing_is_scanned():
     assert not [k for k in _WAIVED if k[0].startswith("monitor/")]
 
 
+def test_overlap_engine_is_scanned():
+    """parallel/overlap.py promises traced flags and static bucket geometry
+    with no host syncs (its docstring cites this scan) — pin that the scanner
+    reaches it with zero sanctions and zero waivers, so a future ``float()``
+    on a found_inf flag (the classic apex-port host-sync bug) fails loudly."""
+    parallel_files = sorted(
+        p.relative_to(_PKG_ROOT).as_posix()
+        for p in (_PKG_ROOT / "parallel").rglob("*.py")
+    )
+    assert "parallel/overlap.py" in parallel_files
+    assert "parallel" not in _SKIP_DIRS
+    assert not any(path.startswith("parallel/") for path in _SANCTIONED_BY_FILE)
+    assert not any(path.startswith("parallel/") for path, _ in _WAIVED)
+
+
 def test_remat_and_memory_ledger_are_scanned():
     """remat/ (policies + donation) and the memory ledger promise host-side
     metadata work ONLY (shapes, treedefs, compiler stats — never a traced
