@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 
 import jax
 
+from beforeholiday_tpu.parallel.bucketing import static_axis_size
 from beforeholiday_tpu.parallel.sync_batch_norm import (
     BatchNormParams,
     BatchNormState,
@@ -59,7 +60,7 @@ def batch_norm_nhwc(
         if axis_name is None:
             raise ValueError("bn_group > 1 needs axis_name (inside shard_map)")
         if world_size is None:
-            world_size = jax.lax.axis_size(axis_name)
+            world_size = static_axis_size(axis_name)
         groups = bn_group_ranks(world_size, bn_group)
     return sync_batch_norm(
         x, params, state,

@@ -16,6 +16,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from beforeholiday_tpu.parallel.bucketing import static_axis_size
+
 
 def halo_exchange_1d(
     x: jax.Array,
@@ -33,7 +35,7 @@ def halo_exchange_1d(
     get zeros unless ``wrap`` (ref: peer_halo_exchanger_1d's top/btm split —
     zero-filled boundaries match conv zero padding).
     """
-    size = jax.lax.axis_size(axis_name)
+    size = static_axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     n = x.shape[dim]
     if halo <= 0 or halo > n:

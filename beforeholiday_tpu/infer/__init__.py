@@ -1,0 +1,61 @@
+"""Serving — the second workload front: AOT continuous-batching inference.
+
+Built entirely on machinery the training stack already ships:
+
+* :mod:`beforeholiday_tpu.infer.kvcache`  — paged KV-cache (fixed pages +
+  per-request page tables on one arena allocation; page 0 reserved as the
+  null page absorbing padded writes) and the host-side page allocator.
+* :mod:`beforeholiday_tpu.infer.engine`   — bucketed, AOT-compiled prefill
+  and single-token decode (``jit(...).lower().compile()`` per declared
+  signature; the paged cache donated through every step; the recompile
+  sentinel promoted to a hard bucket gate; optional one-time bf16 weight
+  cast via the amp stack).
+* :mod:`beforeholiday_tpu.infer.batching` — Orca-style continuous batching:
+  admit/evict at decode-step granularity against the page budget, preempt
+  by recompute on famine, plus the static-batching baseline the bench pairs
+  it with.
+
+The async open-loop request driver (with the crash flight recorder wired
+in) lives in ``examples/serve/``; the bench rungs in
+``testing/infer_bench.py`` surface through ``bench.py``.
+"""
+
+from beforeholiday_tpu.infer.batching import (  # noqa: F401
+    ContinuousBatcher,
+    Request,
+    static_batched_generate,
+)
+from beforeholiday_tpu.infer.engine import (  # noqa: F401
+    EngineConfig,
+    InferenceEngine,
+    pick_bucket,
+)
+from beforeholiday_tpu.infer.kvcache import (  # noqa: F401
+    KVCache,
+    NULL_PAGE,
+    PageAllocator,
+    PagedLayout,
+    alloc_cache,
+    gather_pages,
+    pages_for,
+    write_prefill,
+    write_token,
+)
+
+__all__ = [
+    "ContinuousBatcher",
+    "EngineConfig",
+    "InferenceEngine",
+    "KVCache",
+    "NULL_PAGE",
+    "PageAllocator",
+    "PagedLayout",
+    "Request",
+    "alloc_cache",
+    "gather_pages",
+    "pages_for",
+    "pick_bucket",
+    "static_batched_generate",
+    "write_prefill",
+    "write_token",
+]

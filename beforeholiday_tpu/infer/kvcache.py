@@ -1,0 +1,238 @@
+"""Paged KV-cache — vLLM-style PagedAttention storage on the flat-arena stack.
+
+Serving batches are ragged: every request holds a different number of cached
+key/value tokens and grows by one token per decode step. A contiguous
+(B, max_seq, KV) cache wastes HBM on the gap between each request's length
+and the max, and admitting/evicting a request would reshape the buffer — a
+recompile. The paged layout (Kwon et al., SOSP '23) fixes both: the cache is
+a fixed pool of fixed-size pages, and each request owns a *page table* — an
+int32 row mapping its logical slots to physical pages. Admission allocates
+pages from a host-side free list; eviction returns them. The device arrays
+never change shape, so the decode executable compiles once per batch bucket.
+
+Layout choices, in the repo's idiom:
+
+* one HBM allocation: k-pages and v-pages for ALL layers are carved out of a
+  single flat arena buffer (``ops/arena.py``'s ``make_spec``/``unflatten``),
+  allocated once at engine construction and donated through every decode
+  step (``remat/donation.py``) so XLA updates it in place;
+* pages are stacked per layer — ``(n_layers, n_pages, page_size, kv_dim)``
+  — so the engine's ``lax.scan`` over layers consumes one page-pool slice
+  per step, matching the stacked-block parameter layout of the test models;
+* **page 0 is the reserved null page**: page-table rows are padded with 0,
+  so writes from padding slots (inactive batch rows, prompt padding past a
+  request's last real page) land harmlessly in page 0, and reads of padded
+  slots are masked by ``kv_lens`` in the attention kernel — no dynamic
+  shapes, no host-side masking, no ``where`` over the whole pool.
+
+Everything here is either pure device math on statically-shaped arrays (the
+write/gather helpers, called inside the engine's jitted steps) or pure host
+bookkeeping over Python ints (the allocator, called between steps by the
+scheduler). Nothing syncs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.ops import arena
+
+__all__ = [
+    "KVCache",
+    "NULL_PAGE",
+    "PageAllocator",
+    "PagedLayout",
+    "alloc_cache",
+    "gather_pages",
+    "pages_for",
+    "write_prefill",
+    "write_token",
+]
+
+# physical page 0 absorbs writes from padded page-table slots; the allocator
+# never hands it out and kv_lens masking hides whatever lands there
+NULL_PAGE = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedLayout:
+    """Static geometry of a paged cache (hashable: rides jit static args)."""
+
+    n_layers: int
+    n_pages: int  # physical pages per layer, INCLUDING the reserved null page
+    page_size: int  # tokens per page
+    kv_dim: int  # n_heads * head_dim
+    dtype_name: str = "float32"
+
+    def __post_init__(self):
+        if self.n_pages < 2:
+            raise ValueError(
+                f"n_pages={self.n_pages}: need >= 2 (page 0 is reserved)"
+            )
+        if self.page_size < 1 or self.kv_dim < 1 or self.n_layers < 1:
+            raise ValueError(f"degenerate layout: {self}")
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.dtype_name)
+
+    @property
+    def usable_pages(self) -> int:
+        return self.n_pages - 1
+
+    @property
+    def tokens_per_layer(self) -> int:
+        return self.usable_pages * self.page_size
+
+
+def pages_for(n_tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``n_tokens`` (ceil division)."""
+    return -(-n_tokens // page_size)
+
+
+@jax.tree_util.register_pytree_node_class
+class KVCache:
+    """The paged pools as a pytree: ``k``/``v`` are traced children shaped
+    ``(n_layers, n_pages, page_size, kv_dim)``, the layout is static aux
+    data — so a ``KVCache`` passes through jit/donate transparently."""
+
+    __slots__ = ("k", "v", "layout")
+
+    def __init__(self, k: jax.Array, v: jax.Array, layout: PagedLayout):
+        self.k = k
+        self.v = v
+        self.layout = layout
+
+    def tree_flatten(self):
+        return (self.k, self.v), self.layout
+
+    @classmethod
+    def tree_unflatten(cls, layout, children):
+        return cls(*children, layout)
+
+    def replace(self, k: jax.Array, v: jax.Array) -> "KVCache":
+        return KVCache(k, v, self.layout)
+
+
+def alloc_cache(layout: PagedLayout) -> KVCache:
+    """Allocate the k/v page pools out of ONE flat arena buffer.
+
+    A single zeros allocation padded to the arena tile is carved into the two
+    pools with static slices (``arena.unflatten``) — the same one-buffer
+    discipline as the fused optimizers' parameter arenas, so the whole cache
+    is one donation unit and one HBM region for the life of the engine."""
+    shape = (layout.n_layers, layout.n_pages, layout.page_size, layout.kv_dim)
+    spec = arena.make_spec(
+        [jax.ShapeDtypeStruct(shape, layout.dtype)] * 2
+    )
+    flat = jnp.zeros((spec.padded_total,), layout.dtype)
+    k, v = arena.unflatten(flat, spec)
+    return KVCache(k, v, layout)
+
+
+# ---------------------------------------------------------------------------------
+# device-side page ops — called inside the engine's jitted steps, per layer
+# ---------------------------------------------------------------------------------
+
+
+def write_token(pages: jax.Array, page_table: jax.Array, pos: jax.Array,
+                val: jax.Array) -> jax.Array:
+    """Scatter one new token per sequence into its page.
+
+    ``pages``: (n_pages, page_size, kv_dim) — ONE layer's pool.
+    ``page_table``: (B, n_slots) int32. ``pos``: (B,) int32 — the logical
+    position being written (== tokens already cached). ``val``: (B, kv_dim).
+
+    Inactive batch rows carry an all-null page table, so their write lands in
+    page 0 (duplicate scatter indices there are fine — the null page's
+    content is never read unmasked)."""
+    ps = pages.shape[1]
+    batch = jnp.arange(pos.shape[0])
+    phys = page_table[batch, pos // ps]
+    return pages.at[phys, pos % ps].set(val.astype(pages.dtype))
+
+
+def write_prefill(pages: jax.Array, page_table: jax.Array,
+                  vals: jax.Array) -> jax.Array:
+    """Bulk-scatter a whole prompt's K or V into its pages.
+
+    ``vals``: (B, S, kv_dim) with ``S % page_size == 0`` — the prefill seq
+    bucket is page-aligned by construction, so the scatter is a reshape to
+    (B * n_slots, page_size, kv_dim) chunks indexed by the table's first
+    ``S / page_size`` slots. Positions past a request's real length either
+    fall in null-page slots (masked forever) or in the tail of its last real
+    page (masked by ``kv_lens`` until the decode loop overwrites them —
+    decode token ``t`` lands at exactly offset ``t % page_size``)."""
+    B, S, kv = vals.shape
+    ps = pages.shape[1]
+    if S % ps:
+        raise ValueError(
+            f"prefill length {S} must be a multiple of page_size {ps}"
+        )
+    n_slots = S // ps
+    phys = page_table[:, :n_slots].reshape(-1)
+    chunks = vals.astype(pages.dtype).reshape(B * n_slots, ps, kv)
+    return pages.at[phys].set(chunks)
+
+
+def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Materialize each sequence's logically-contiguous K or V view.
+
+    (n_pages, page_size, kv_dim) gathered by (B, n_slots) → (B, n_slots *
+    page_size, kv_dim). Token at logical position ``p`` sits at row ``p`` of
+    the view; junk past each request's length is masked by ``kv_lens`` in
+    the attention call, never inspected."""
+    B, n_slots = page_table.shape
+    ps, kv = pages.shape[1], pages.shape[2]
+    return pages[page_table].reshape(B, n_slots * ps, kv)
+
+
+# ---------------------------------------------------------------------------------
+# host-side page accounting — scheduler territory, plain ints, zero device work
+# ---------------------------------------------------------------------------------
+
+
+class PageAllocator:
+    """Free-list over physical pages ``1 .. n_pages-1`` (page 0 reserved).
+
+    All-or-nothing allocation: the continuous batcher admits a request only
+    if its whole ask fits, and preempts (rather than partially allocating)
+    when the pool runs dry mid-decode. Double-free and foreign-page frees
+    raise — an accounting bug here silently corrupts another request's cache,
+    so it must be loud."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(f"n_pages={n_pages}: need >= 2 (page 0 reserved)")
+        self.n_pages = n_pages
+        self._free = deque(range(1, n_pages))
+        self._allocated: set = set()
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages, or None if the pool can't cover the whole ask."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(
+                    f"freeing page {p} not currently allocated "
+                    f"(double free or foreign page)"
+                )
+            self._allocated.remove(p)
+            self._free.append(p)

@@ -70,6 +70,7 @@ from beforeholiday_tpu.monitor.comms import (  # noqa: F401
     reset_comms_ledger,
 )
 from beforeholiday_tpu.monitor.compile import (  # noqa: F401
+    BucketGateError,
     compile_counts,
     compile_summary,
     reset_compile_counts,
@@ -109,6 +110,7 @@ from beforeholiday_tpu.monitor.flight import (  # noqa: F401
 )
 
 __all__ = [
+    "BucketGateError",
     "ChipSpec",
     "FlightRecorder",
     "Metrics",

@@ -42,11 +42,18 @@ import numpy as np
 from beforeholiday_tpu.utils.logging import reset_warn_once, warn_once
 
 __all__ = [
+    "BucketGateError",
     "compile_counts",
     "compile_summary",
     "reset_compile_counts",
     "track_compiles",
 ]
+
+
+class BucketGateError(RuntimeError):
+    """A strict-mode entry point was called with an abstract signature beyond
+    its declared bucket budget — the recompile storm the sentinel warns about,
+    promoted to a hard failure for serving-class entry points."""
 
 _LOCK = threading.Lock()
 # entry name -> {"signatures": {sig: first-call index}, "calls": n}
@@ -81,13 +88,24 @@ def _describe(sig) -> str:
     )
 
 
-def track_compiles(entry: str):
+def track_compiles(entry: str, *, strict: bool = False,
+                   max_signatures: int | None = None):
     """Decorator: count abstract-signature changes of a jitted entry point.
 
     Apply OUTSIDE ``jax.jit`` so the wrapper sees concrete arguments. The
     first signature is the expected compile; each NEW signature thereafter
     increments the entry's compile count and (once per entry, via
-    ``warn_once``) logs a recompile warning naming the old and new shapes."""
+    ``warn_once``) logs a recompile warning naming the old and new shapes.
+
+    ``strict=True`` with ``max_signatures=N`` promotes the sentinel to a
+    HARD GATE: the N declared bucket signatures compile normally, but a call
+    whose signature would be the (N+1)-th raises :class:`BucketGateError`
+    BEFORE dispatch (and before registering the signature, so retries keep
+    failing rather than laundering the overflow into the known set). This is
+    the serving-path contract — a finite bucket set is declared up front and
+    an out-of-bucket shape is a bug, not a warning."""
+    if strict and max_signatures is None:
+        raise ValueError("strict=True requires max_signatures")
 
     def deco(fn):
         @functools.wraps(fn)
@@ -100,10 +118,21 @@ def track_compiles(entry: str):
                 row["calls"] += 1
                 known = row["signatures"]
                 is_new = sig not in known
+                if (
+                    is_new
+                    and strict
+                    and len(known) >= max_signatures
+                ):
+                    raise BucketGateError(
+                        f"entry {entry!r}: signature outside the declared "
+                        f"bucket set (budget {max_signatures}, already "
+                        f"compiled {len(known)}): {_describe(sig)} — pad to "
+                        f"a declared bucket or widen the bucket set"
+                    )
                 if is_new:
                     known[sig] = row["calls"]
                 n_sigs = len(known)
-            if is_new and n_sigs > 1:
+            if is_new and n_sigs > 1 and not strict:
                 warn_once(
                     (_WARN_PREFIX, entry),
                     "recompile sentinel: entry %r compiled %d distinct "

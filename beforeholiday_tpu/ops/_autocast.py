@@ -48,8 +48,20 @@ try:
         include_in_jit_key=True,
         include_in_trace_context=True,
     )
-except Exception:  # pragma: no cover - future jax relocation
+    _xla_metadata = None
+except Exception:
+    # jax < 0.6: extra_jit_context is a FIXED NamedTuple — custom config
+    # states cannot join the jit key (include_in_jit_key silently no-ops for
+    # user states). The xla_metadata context manager IS part of
+    # config.trace_context() there, so riding it gives the same cache-key
+    # participation; the scope value itself lives in the thread-local below.
+    # Side effect: ops traced inside autocast carry a frontend attribute —
+    # metadata only, no semantic change.
     _dtype_state = None
+    try:
+        from jax.experimental.xla_metadata import set_xla_metadata as _xla_metadata
+    except Exception:  # pragma: no cover - future jax relocation
+        _xla_metadata = None
 
 
 class _State(threading.local):
@@ -71,7 +83,11 @@ def autocast(dtype):
         prev = getattr(_state, "dtype", None)
         _state.dtype = name
         try:
-            yield
+            if _xla_metadata is not None:
+                with _xla_metadata(beforeholiday_tpu_autocast=name):
+                    yield
+            else:
+                yield
         finally:
             _state.dtype = prev
 

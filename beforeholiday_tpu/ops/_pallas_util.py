@@ -6,11 +6,37 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.pallas import tpu as _pltpu
+
+# jax >= 0.6 spells the TPU compiler-params struct pltpu.CompilerParams;
+# jax 0.4.x ships it as TPUCompilerParams — same fields, renamed. Resolve
+# once here (same getattr-compat idiom as static_axis_size / the shard_map
+# test shims) so kernel modules run on either.
+CompilerParams = getattr(_pltpu, "CompilerParams", None) or _pltpu.TPUCompilerParams
 
 
 def interpret_default() -> bool:
     """Pallas compiles natively on TPU; elsewhere the interpreter runs."""
     return jax.default_backend() != "tpu"
+
+
+def _manual_context_pre_vma() -> bool:
+    """jax < 0.6 fallback (no abstract-mesh/vma API): shard_map binds its
+    manual axes in the trace-time axis env, and ``check_rep=True`` traces
+    the body under a RewriteTrace — the replication checker that rejects
+    opaque pallas_calls, i.e. the role ``check_vma`` plays on newer jax.
+    Manual-and-pallas-safe is therefore: axes bound, no RewriteTrace active.
+    The repo convention shards over ALL mesh axes, so any bound frame counts
+    as fully manual (pmap frames also qualify: one device per shard there
+    too). Fail safe to jnp on any probe breakage, as above."""
+    try:
+        from jax._src import core as _core
+
+        if not _core.get_axis_env().axis_sizes:
+            return False
+        return type(_core.trace_ctx.trace).__name__ != "RewriteTrace"
+    except Exception:
+        return False
 
 
 def in_fully_manual_context() -> bool:
@@ -23,6 +49,8 @@ def in_fully_manual_context() -> bool:
     pallas_call is rejected at trace time because its out_shapes carry no
     ``vma``; the default must stay jnp there rather than regress working
     user code."""
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        return _manual_context_pre_vma()
     try:
         mesh = jax.sharding.get_abstract_mesh()
         if not mesh.axis_names:

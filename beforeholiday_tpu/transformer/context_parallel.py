@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.ops._pallas_util import resolve_impl as _resolve_impl
+from beforeholiday_tpu.parallel.bucketing import static_axis_size
 from beforeholiday_tpu.parallel.parallel_state import CONTEXT_AXIS
 
 _NEG = -1e30
@@ -62,7 +63,7 @@ def ring_attention(
         raise ValueError(f"expected (B, H, S_local, D), got {q.shape}")
     B, H, Sl, D = q.shape
     scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
-    cp = jax.lax.axis_size(axis_name)
+    cp = static_axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 

@@ -238,7 +238,7 @@ def _pipelined_fwd_bwd(
     ``chunk_params``: this device's V chunk slices, each leaf (V, ...);
     chunk v on device s is logical stage v*S + s.
     """
-    S = jax.lax.axis_size(axis_name)
+    S = bucketing.static_axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     M = inputs.shape[0]
     # JAX clamps traced out-of-bounds indexing, so a mismatched microbatch
@@ -1015,7 +1015,7 @@ def forward_backward_pipelining_encoder_decoder(
             "pipeline_model_parallel_split_rank)"
         )
 
-    S = jax.lax.axis_size(axis_name)
+    S = bucketing.static_axis_size(axis_name)
     if not 0 < split_rank < S:
         # split_rank 0 (no encoder) or >= S (no decoder) would run a
         # plausible-looking but wrong schedule: the boundary injection never

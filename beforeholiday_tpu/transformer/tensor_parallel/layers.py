@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from beforeholiday_tpu.monitor.comms import ledger_scope
+from beforeholiday_tpu.parallel import bucketing
 from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
 from beforeholiday_tpu.transformer.tensor_parallel import mappings as mp
 
@@ -88,7 +89,7 @@ def row_parallel_linear(
 def vocab_range(vocab_size: int, axis_name: str = TENSOR_AXIS) -> Tuple[jax.Array, int]:
     """(this rank's first vocab index, local vocab size) —
     ref: VocabUtility.vocab_range_from_global_vocab_size (layers.py:103-115)."""
-    world = jax.lax.axis_size(axis_name)
+    world = bucketing.static_axis_size(axis_name)
     assert vocab_size % world == 0, f"vocab {vocab_size} not divisible by {world}"
     local = vocab_size // world
     return jax.lax.axis_index(axis_name) * local, local

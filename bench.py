@@ -942,6 +942,32 @@ def bench_overlap_engine():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_infer():
+    """Serving rungs (CPU subprocess): continuous vs static batching tokens/s
+    at the same page budget, decode latency percentiles under a seeded
+    open-loop trace, decode MFU through the roofline ledger, and the
+    compiled-signature count against the engine's declared bucket budget.
+    The child asserts the paged decode path against the full-forward greedy
+    oracle before timing anything. Same env scrub as ``bench_pp_overhead``
+    (the axon sitecustomize would otherwise register the TPU backend and the
+    scheduler proxy would time the tunnel)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.infer_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"infer_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 # ---------------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------------
@@ -1243,6 +1269,27 @@ def main():
             "child asserts, wall clock means nothing on this host"
         )
         pass2.update(oe.get("pass2") or {})
+
+    # --- serving rungs: continuous vs static batching (CPU proxy, subprocess) ---
+    inf = _stage(detail, bench_infer)
+    if inf:
+        for k in ("infer_tokens_per_s", "infer_p50_ms", "infer_p99_ms",
+                  "continuous_vs_static_batching", "infer_decode_mfu",
+                  "infer_compiled_signatures", "infer_declared_signatures"):
+            detail[k] = inf.get(k)
+        detail["infer_bench"] = {
+            k: v for k, v in inf.items() if k != "pass2"
+        }
+        detail["infer_note"] = (
+            "open-loop serving proxy on a CPU subprocess: the batching ratio "
+            "and latency percentiles are scheduling wins at an equal page "
+            "budget (same engine, same executables both sides); tokens/s is "
+            "a CPU trend number, not a TPU rate; the child pins paged decode "
+            "against the full-forward greedy oracle and the compiled "
+            "signature count against the declared bucket budget before "
+            "printing"
+        )
+        pass2.update(inf.get("pass2") or {})
 
     # --- guard dispatch + comms + compile counters: what every rung above
     # actually dispatched/communicated/compiled (collected LAST so the

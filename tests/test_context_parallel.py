@@ -11,6 +11,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from beforeholiday_tpu.transformer.context_parallel import ring_attention
 
+# jax >= 0.6 spells varying-axis-tracking-off jax.shard_map(check_vma=False);
+# older jax ships the experimental module with check_rep — same shim as
+# test_data_parallel.py so the suite runs on either
+_shard_map = getattr(jax, "shard_map", None)
+_CHECK_KW = "check_vma"
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def _smap(f, **kw):
+    kw[_CHECK_KW] = False
+    return _shard_map(f, **kw)
+
 
 def _full_attn(q, k, v, causal, scale):
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -27,13 +42,12 @@ def _full_attn(q, k, v, causal, scale):
 
 
 def _run_ring(mesh, q, k, v, causal, scale, impl=None):
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(_smap(
         functools.partial(ring_attention, causal=causal, scale=scale,
                           axis_name="context", impl=impl),
         mesh=mesh,
         in_specs=(P(None, None, "context"),) * 3,
         out_specs=P(None, None, "context"),
-        check_vma=False,
     ))
     return f(q, k, v)
 
@@ -88,10 +102,9 @@ class TestRingAttention:
     def test_shape_validation(self, devices8):
         mesh = Mesh(np.asarray(devices8), ("context",))
         with pytest.raises(ValueError, match="S_local"):
-            jax.shard_map(
+            _smap(
                 lambda q: ring_attention(q, q, q, axis_name="context"),
                 mesh=mesh, in_specs=P(None, "context"), out_specs=P(None, "context"),
-                check_vma=False,
             )(jnp.ones((2, 64, 8)))
 
 

@@ -22,6 +22,21 @@ from beforeholiday_tpu.ops import multi_tensor as mt
 from beforeholiday_tpu.ops.normalization import fused_layer_norm
 from beforeholiday_tpu.ops.softmax import scaled_softmax
 
+# jax >= 0.6 spells varying-axis-tracking-off jax.shard_map(check_vma=False);
+# older jax ships the experimental module with check_rep — same shim as
+# test_data_parallel.py so the suite runs on either
+_shard_map = getattr(jax, "shard_map", None)
+_CHECK_KW = "check_vma"
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def _smap(f, **kw):
+    kw[_CHECK_KW] = False
+    return _shard_map(f, **kw)
+
 
 class TestResolvePolicy:
     def test_explicit_always_honored(self):
@@ -52,8 +67,7 @@ class TestResolvePolicy:
         seen = []
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
-            check_vma=False,
+            _smap, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
         )
         def f(x):
             seen.append(_pallas_util.resolve_impl(None))
@@ -71,7 +85,7 @@ class TestResolvePolicy:
         seen = []
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            _shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
         )
         def f(x):
             seen.append(_pallas_util.resolve_impl(None))
@@ -80,6 +94,11 @@ class TestResolvePolicy:
         jax.eval_shape(f, jax.ShapeDtypeStruct((8, 4), jnp.float32))
         assert seen == ["jnp"]
 
+    @pytest.mark.skipif(
+        not hasattr(jax.sharding, "AxisType"),
+        reason="partial-manual shard_map(axis_names=...) over typed mesh axes "
+               "is a jax>=0.6 API; older jax has no equivalent spelling",
+    )
     def test_partially_manual_context_defaults_jnp(self, monkeypatch, devices8):
         """shard_map over a strict subset of axes leaves Auto axes -> GSPMD
         still partitions the body -> jnp."""
@@ -151,8 +170,7 @@ class TestPallasInsideShardMap:
         src = np.random.RandomState(0).randn(8, 64).astype(np.float32)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=(P("data"), P()),
-            check_vma=False,
+            _smap, mesh=mesh, in_specs=P("data"), out_specs=(P("data"), P()),
         )
         def f(x):
             outs, found_inf = mt.multi_tensor_scale([x[0]], 2.0, impl="pallas")
@@ -170,8 +188,7 @@ class TestPallasInsideShardMap:
         b = rng.randn(128).astype(np.float32)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=(P("data"), P(), P()), out_specs=P("data"),
-            check_vma=False,
+            _smap, mesh=mesh, in_specs=(P("data"), P(), P()), out_specs=P("data"),
         )
         def f(xs, g, b):
             return fused_layer_norm(xs, g, b, impl="pallas")
@@ -185,8 +202,7 @@ class TestPallasInsideShardMap:
         x = np.random.RandomState(2).randn(8, 128, 64).astype(np.float32)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
-            check_vma=False,
+            _smap, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
         )
         def f(xs):
             return scaled_softmax(xs, 0.5, impl="pallas")

@@ -19,6 +19,21 @@ from beforeholiday_tpu.transformer.tensor_parallel.random import (
     model_parallel_seed,
 )
 
+# jax >= 0.6 spells varying-axis-tracking-off jax.shard_map(check_vma=False);
+# older jax ships the experimental module with check_rep — same shim as
+# test_data_parallel.py so the suite runs on either
+_shard_map = getattr(jax, "shard_map", None)
+_CHECK_KW = "check_vma"
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def _smap(f, **kw):
+    kw[_CHECK_KW] = False
+    return _shard_map(f, **kw)
+
 
 class TestDropoutPrimitive:
     def test_identity_when_deterministic(self):
@@ -61,8 +76,7 @@ class TestTPDistinctMasks:
         x = jnp.ones((4, 128))
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor"),
-            check_vma=False,
+            _smap, mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor"),
         )
         def f(x_local):
             return dropout(jax.random.PRNGKey(3), x_local, 0.5, tp_distinct=True)
@@ -78,8 +92,7 @@ class TestTPDistinctMasks:
         x = jnp.ones((4, 128))
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor"),
-            check_vma=False,
+            _smap, mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor"),
         )
         def f(x_local):
             return dropout(jax.random.PRNGKey(3), x_local, 0.5)
@@ -92,8 +105,7 @@ class TestTPDistinctMasks:
         mesh = Mesh(np.asarray(devices8[:4]), ("tensor",))
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=(), out_specs=P("tensor"),
-            check_vma=False,
+            _smap, mesh=mesh, in_specs=(), out_specs=P("tensor"),
         )
         def f():
             return model_parallel_seed(jax.random.PRNGKey(0))[None]

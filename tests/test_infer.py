@@ -1,0 +1,369 @@
+"""Serving subsystem (ISSUE 8 acceptance contracts):
+
+* paged-KV decode is numerically the SAME attention as a contiguous
+  forward: decode logits match the full-forward reference at the same
+  position (allclose) and the incremental greedy trajectory is identical
+  token-for-token to full-prefill greedy argmax;
+* padding rows ride the null page + ``kv_lens`` masking and cannot perturb
+  live rows;
+* the recompile sentinel promoted to a HARD gate: an abstract signature
+  outside the declared bucket budget raises ``BucketGateError`` instead of
+  warn-once, both at the ``track_compiles`` unit level and through the
+  engine's gated entry points;
+* the page allocator is all-or-nothing under famine and catches double/
+  foreign frees;
+* continuous batching completes every request, returns every page, and its
+  outputs are byte-identical to static batching (greedy decode makes the
+  schedule invisible in the tokens); preemption-by-recompute replays
+  byte-identically under page famine;
+* the serving driver's request loop dumps the crash flight recorder on the
+  way out of an injected failure.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beforeholiday_tpu.infer import (
+    ContinuousBatcher,
+    EngineConfig,
+    InferenceEngine,
+    PageAllocator,
+    Request,
+    pages_for,
+    pick_bucket,
+    static_batched_generate,
+)
+from beforeholiday_tpu.monitor import BucketGateError, track_compiles
+from beforeholiday_tpu.testing import gpt
+
+pytestmark = pytest.mark.infer
+
+TINY = dict(vocab_size=64, seq_len=64, d_model=32, n_heads=2, n_layers=2,
+            dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = gpt.GPTConfig(**TINY)
+    return cfg, gpt.init(jax.random.PRNGKey(0), cfg)
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_model):
+    cfg, params = tiny_model
+    ecfg = EngineConfig(
+        max_seq_len=32, page_size=8, num_pages=17, batch_buckets=(2, 4),
+        prefill_seq_buckets=(8, 16, 32), entry_prefix="infer_test_shared",
+    )
+    return InferenceEngine(params, cfg, ecfg)
+
+
+def _greedy_reference(params, cfg, prompt, n_new):
+    """Full-forward greedy continuation — the trajectory oracle."""
+    seq = list(prompt)
+    for _ in range(n_new):
+        logits = gpt.forward(params, jnp.asarray([seq], jnp.int32), cfg)
+        seq.append(int(np.argmax(np.asarray(logits[0, len(seq) - 1]))))
+    return seq[len(prompt):]
+
+
+# ---------------------------------------------------------------- host pieces
+
+
+def test_pages_for():
+    assert pages_for(1, 8) == 1
+    assert pages_for(8, 8) == 1
+    assert pages_for(9, 8) == 2
+    assert pages_for(0, 8) == 0
+
+
+def test_pick_bucket():
+    assert pick_bucket(1, (2, 4)) == 2
+    assert pick_bucket(3, (2, 4)) == 4
+    assert pick_bucket(4, (2, 4)) == 4
+    with pytest.raises(ValueError):
+        pick_bucket(5, (2, 4))
+
+
+def test_engine_config_validation():
+    with pytest.raises(ValueError):  # bucket not page-aligned
+        EngineConfig(max_seq_len=32, page_size=8, prefill_seq_buckets=(12,))
+    with pytest.raises(ValueError):  # max_seq_len not page-aligned
+        EngineConfig(max_seq_len=30, page_size=8, prefill_seq_buckets=(8,))
+    with pytest.raises(ValueError):  # buckets must ascend
+        EngineConfig(max_seq_len=32, page_size=8, batch_buckets=(4, 2),
+                     prefill_seq_buckets=(8,))
+    with pytest.raises(ValueError):  # bucket beyond max_seq_len
+        EngineConfig(max_seq_len=16, page_size=8, prefill_seq_buckets=(8, 32))
+
+
+def test_page_allocator_all_or_nothing_and_free():
+    alloc = PageAllocator(6)  # pages 1..5 usable; 0 is the null page
+    got = alloc.alloc(3)
+    assert got is not None and len(got) == 3 and 0 not in got
+    assert alloc.available == 2
+    assert alloc.alloc(3) is None  # famine: all-or-nothing, nothing consumed
+    assert alloc.available == 2
+    alloc.free(got)
+    assert alloc.available == 5
+    with pytest.raises(ValueError):  # double free
+        alloc.free(got)
+    with pytest.raises(ValueError):  # foreign page (the null page)
+        alloc.free([0])
+
+
+# ------------------------------------------------------- decode correctness
+
+
+def test_decode_logits_match_full_forward(tiny_model, engine):
+    """The paged-vs-contiguous oracle: logits from a paged incremental decode
+    step equal the full (contiguous) forward at the same position."""
+    cfg, params = tiny_model
+    engine.reset_cache()
+    alloc = PageAllocator(engine.cfg.num_pages)
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+    tables = [alloc.alloc(pages_for(len(p) + 1, 8)) for p in prompts]
+    engine.prefill(prompts, tables)
+    feed = [7, 11]  # arbitrary next tokens (not the greedy ones)
+    paged = engine.decode_logits(feed, [len(p) for p in prompts], tables)
+    for i, p in enumerate(prompts):
+        seq = p + [feed[i]]
+        full = gpt.forward(params, jnp.asarray([seq], jnp.int32), cfg)
+        np.testing.assert_allclose(
+            paged[i], np.asarray(full[0, len(seq) - 1]), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_incremental_greedy_matches_full_prefill(tiny_model, engine):
+    """Trajectory parity: prefill + N single-token decode steps produce the
+    same greedy tokens as N full forwards over the growing sequence."""
+    cfg, params = tiny_model
+    engine.reset_cache()
+    alloc = PageAllocator(engine.cfg.num_pages)
+    prompts = [[5, 9, 2, 7, 1, 3], [11, 4, 8]]
+    n_new = 6
+    tables = [alloc.alloc(pages_for(len(p), 8)) for p in prompts]
+    outs = [[] for _ in prompts]
+    toks = engine.prefill(prompts, tables).tolist()
+    lens = [len(p) for p in prompts]
+    for i, t in enumerate(toks):
+        outs[i].append(t)
+    for _ in range(n_new - 1):
+        for i in range(len(prompts)):
+            while len(tables[i]) * 8 <= lens[i]:
+                tables[i] += alloc.alloc(1)
+        toks = engine.decode(toks, lens, tables).tolist()
+        for i, t in enumerate(toks):
+            outs[i].append(t)
+            lens[i] += 1
+    for i, p in enumerate(prompts):
+        assert outs[i] == _greedy_reference(params, cfg, p, n_new)
+
+
+def test_padding_rows_cannot_perturb_live_rows(engine):
+    """A live row's logits are identical whether it shares the bucket with
+    another live row or with a padded (null-page, len-0) row — the null-page
+    write + kv_lens masking contract."""
+    engine.reset_cache()
+    alloc = PageAllocator(engine.cfg.num_pages)
+    p0, p1 = [3, 1, 4, 1], [9, 2, 6, 5]
+    t0 = alloc.alloc(1)
+    t1 = alloc.alloc(1)
+    engine.prefill([p0, p1], [t0, t1])
+    solo = engine.decode_logits([7], [len(p0)], [t0])  # row 1 is padding
+    engine.reset_cache()
+    alloc = PageAllocator(engine.cfg.num_pages)
+    t0 = alloc.alloc(1)
+    t1 = alloc.alloc(1)
+    engine.prefill([p0, p1], [t0, t1])
+    both = engine.decode_logits([7, 8], [len(p0), len(p1)], [t0, t1])
+    np.testing.assert_allclose(solo[0], both[0], rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------------------------ the hard gate
+
+
+def test_track_compiles_strict_gate_unit():
+    gated = track_compiles("infer_test_gate_unit", strict=True,
+                           max_signatures=1)(lambda x: x + 1)
+    gated(jnp.zeros((2,)))
+    with pytest.raises(BucketGateError):
+        gated(jnp.zeros((3,)))
+    # a declared (already-known) signature keeps working after the raise
+    gated(jnp.zeros((2,)))
+    # the offending signature must NOT have been registered by the failure
+    with pytest.raises(BucketGateError):
+        gated(jnp.zeros((3,)))
+
+
+def test_track_compiles_strict_requires_budget():
+    with pytest.raises(ValueError):
+        track_compiles("infer_test_gate_nobudget", strict=True)
+
+
+def test_engine_gate_rejects_undeclared_signature(tiny_model):
+    """Through the engine: the host API pads everything to declared buckets
+    (so it can never trip the gate); a shape that bypasses the bucket table
+    raises BucketGateError at the gated entry instead of compiling."""
+    cfg, params = tiny_model
+    ecfg = EngineConfig(
+        max_seq_len=16, page_size=8, num_pages=9, batch_buckets=(2,),
+        prefill_seq_buckets=(8,), entry_prefix="infer_test_gate_engine",
+    )
+    eng = InferenceEngine(params, cfg, ecfg)
+    alloc = PageAllocator(ecfg.num_pages)
+    tables = [alloc.alloc(1), alloc.alloc(1)]
+    toks = eng.prefill([[1, 2, 3], [4, 5]], tables)
+    assert eng.compiled_signatures == 1
+    # host API: batch 3 exceeds the largest bucket -> actionable ValueError
+    with pytest.raises(ValueError):
+        eng.prefill([[1], [2], [3]], [[1], [2], [3]])
+    # consume the declared decode budget (the gate is count-based: it holds
+    # each entry to its declared NUMBER of signatures)
+    eng.decode(toks.tolist(), [3, 2], tables)
+    assert eng.compiled_signatures == 2
+    # gated entry: a further, undeclared decode batch raises instead of
+    # compiling a 2nd decode signature
+    with pytest.raises(BucketGateError):
+        eng._decode_gated(
+            eng._params, eng._cache,
+            jnp.zeros((3,), jnp.int32), jnp.zeros((3,), jnp.int32),
+            jnp.zeros((3, ecfg.n_slots), jnp.int32),
+        )
+    # the declared decode bucket still works after the refusal
+    for i in range(2):
+        while len(tables[i]) * 8 <= [4, 3][i]:
+            tables[i] += alloc.alloc(1)
+    eng.decode(toks.tolist(), [4, 3], tables)
+    assert eng.compiled_signatures <= ecfg.declared_signatures
+
+
+# ------------------------------------------------------- continuous batching
+
+
+def _requests(specs):
+    return [Request(rid=i, prompt=list(p), max_new_tokens=n)
+            for i, (p, n) in enumerate(specs)]
+
+
+SPECS = [([3, 1, 4], 6), ([1, 5], 2), ([9, 2, 6, 5, 3], 8),
+         ([5, 8], 1), ([7, 7, 7], 5), ([2, 4, 6, 8], 4)]
+
+
+def test_continuous_completes_and_returns_pages(engine):
+    engine.reset_cache()
+    bat = ContinuousBatcher(engine, now_fn=lambda: 1.0)
+    for r in _requests(SPECS):
+        bat.submit(r)
+    fin = bat.run(max_steps=200)
+    assert sorted(r.rid for r in fin) == list(range(len(SPECS)))
+    assert all(len(r.out) == r.max_new_tokens for r in fin)
+    assert all(not r.pages for r in fin)
+    assert bat.allocator.available == engine.cfg.num_pages - 1
+    assert all(r.finish_time is not None and r.first_token_time is not None
+               for r in fin)
+
+
+def test_continuous_matches_static_outputs(engine):
+    engine.reset_cache()
+    bat = ContinuousBatcher(engine, now_fn=lambda: 1.0)
+    for r in _requests(SPECS):
+        bat.submit(r)
+    cont = {r.rid: r.out for r in bat.run(max_steps=200)}
+    engine.reset_cache()
+    stat = {r.rid: r.out for r in
+            static_batched_generate(engine, _requests(SPECS),
+                                    now_fn=lambda: 1.0)}
+    assert cont == stat  # greedy decode: the schedule is invisible
+
+
+def test_submit_validation(engine):
+    bat = ContinuousBatcher(engine)
+    with pytest.raises(ValueError):  # prompt + new tokens exceed residency
+        bat.submit(Request(rid=0, prompt=[1] * 30, max_new_tokens=10))
+    with pytest.raises(ValueError):
+        bat.submit(Request(rid=1, prompt=[1], max_new_tokens=0))
+
+
+def test_preemption_replays_byte_identically(tiny_model):
+    """Page famine preempts the youngest request; its later re-prefill over
+    prompt+generated must continue the exact same greedy trajectory."""
+    cfg, params = tiny_model
+    ecfg = EngineConfig(
+        max_seq_len=32, page_size=8, num_pages=6, batch_buckets=(2, 4),
+        prefill_seq_buckets=(8, 16, 32), entry_prefix="infer_test_preempt",
+    )
+    eng = InferenceEngine(params, cfg, ecfg)  # 5 usable pages -> famine
+    specs = [([3, 1, 4], 12), ([9, 2, 6], 12), ([5, 8, 1], 10)]
+    bat = ContinuousBatcher(eng, now_fn=lambda: 1.0)
+    for r in _requests(specs):
+        bat.submit(r)
+    fin = {r.rid: r for r in bat.run(max_steps=400)}
+    assert sum(r.preemptions for r in fin.values()) >= 1
+    assert bat.allocator.available == ecfg.num_pages - 1
+    for i, (p, n) in enumerate(specs):
+        assert fin[i].out == _greedy_reference(params, cfg, p, n)
+
+
+# --------------------------------------------------------- serving driver
+
+
+def _load_driver():
+    path = (pathlib.Path(__file__).resolve().parents[1]
+            / "examples" / "serve" / "driver.py")
+    spec = importlib.util.spec_from_file_location("serve_driver", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_driver_crash_dumps_flight_recorder(tiny_model, tmp_path):
+    """An injected request-loop failure propagates AND leaves the black box:
+    the flight dump holds the last scheduler states with the exception
+    reason — the trainer-crash contract applied to serving."""
+    cfg, params = tiny_model
+    driver = _load_driver()
+    ecfg = EngineConfig(
+        max_seq_len=16, page_size=8, num_pages=9, batch_buckets=(2,),
+        prefill_seq_buckets=(8,), entry_prefix="infer_test_driver",
+    )
+    eng = InferenceEngine(params, cfg, ecfg)
+    trace = driver.synthetic_trace(
+        2, 1000.0, seed=1, prompt_range=(2, 4), new_tokens_range=(3, 5),
+        vocab=TINY["vocab_size"],
+    )
+    flight = tmp_path / "flight.json"
+    with pytest.raises(RuntimeError, match="injected request-loop failure"):
+        driver.serve(trace, eng, flight_path=str(flight), fail_after_steps=2)
+    payload = json.loads(flight.read_text())
+    assert payload["reason"].startswith("exception:RuntimeError")
+    assert payload["n_snapshots"] >= 1
+    snap = payload["snapshots"][-1]
+    metrics = snap["metrics"] if "metrics" in snap else snap
+    assert "free_pages" in metrics and "active" in metrics
+
+
+def test_driver_serve_completes_clean(tiny_model, tmp_path):
+    cfg, params = tiny_model
+    driver = _load_driver()
+    ecfg = EngineConfig(
+        max_seq_len=16, page_size=8, num_pages=9, batch_buckets=(2,),
+        prefill_seq_buckets=(8,), entry_prefix="infer_test_driver_ok",
+    )
+    eng = InferenceEngine(params, cfg, ecfg)
+    trace = driver.synthetic_trace(
+        3, 1000.0, seed=2, prompt_range=(2, 4), new_tokens_range=(2, 4),
+        vocab=TINY["vocab_size"],
+    )
+    flight = tmp_path / "flight.json"
+    fin = driver.serve(trace, eng, flight_path=str(flight))
+    assert len(fin) == 3
+    assert all(len(r.out) == r.max_new_tokens for r in fin)
+    assert not flight.exists()  # no crash, no dump

@@ -38,11 +38,17 @@ _SKIP_DIRS = frozenset({"testing", "models"})
 # trace recorder's ``export`` is its one file-write path (host dicts only —
 # it never reads a device value), and the flight recorder's ``dump`` is the
 # crash-dump write path (it serializes already-drained host rows) — nothing
-# else in monitor/ may sync
+# else in monitor/ may sync. The serving engine's host surface (prefill/
+# decode/decode_logits — serving cannot emit a token without reading it
+# back) and the batcher's scheduler drive points are the inference
+# subsystem's sanctioned boundary; everything below them (the step
+# functions, the paged cache ops) must stay sync-free
 _SANCTIONED_BY_FILE = {
     "monitor/export.py": frozenset({"drain", "flush", "_fetch"}),
     "monitor/trace.py": frozenset({"export"}),
     "monitor/flight.py": frozenset({"dump"}),
+    "infer/engine.py": frozenset({"prefill", "decode", "decode_logits"}),
+    "infer/batching.py": frozenset({"step", "static_batched_generate"}),
 }
 
 # file-scoped waivers for sync points that are part of a documented host-side
@@ -148,6 +154,7 @@ def test_monitor_package_is_scanned():
     assert "monitor" not in _SKIP_DIRS
     assert set(_SANCTIONED_BY_FILE) == {
         "monitor/export.py", "monitor/trace.py", "monitor/flight.py",
+        "infer/engine.py", "infer/batching.py",
     }
     assert _SANCTIONED_BY_FILE["monitor/export.py"] == {"drain", "flush", "_fetch"}
     assert _SANCTIONED_BY_FILE["monitor/trace.py"] == {"export"}
@@ -207,6 +214,34 @@ def test_overlap_engine_is_scanned():
     assert "parallel" not in _SKIP_DIRS
     assert not any(path.startswith("parallel/") for path in _SANCTIONED_BY_FILE)
     assert not any(path.startswith("parallel/") for path, _ in _WAIVED)
+
+
+def test_infer_package_is_scanned():
+    """infer/ promises that everything below the engine's host surface is
+    sync-free: the traced step functions and the paged-cache ops never read a
+    device value, and the ONLY sanctioned boundary is where serving must read
+    tokens back — the engine's prefill/decode/decode_logits and the batcher's
+    scheduler drive points. Pin that the scanner reaches every infer file,
+    that the sanction set is exactly that boundary, and that nothing in
+    infer/ carries a waiver — a future ``.item()`` inside a step function or
+    the page allocator fails loudly."""
+    infer_files = sorted(
+        p.relative_to(_PKG_ROOT).as_posix()
+        for p in (_PKG_ROOT / "infer").rglob("*.py")
+    )
+    assert "infer/engine.py" in infer_files
+    assert "infer/kvcache.py" in infer_files
+    assert "infer/batching.py" in infer_files
+    assert "infer" not in _SKIP_DIRS
+    assert _SANCTIONED_BY_FILE["infer/engine.py"] == {
+        "prefill", "decode", "decode_logits",
+    }
+    assert _SANCTIONED_BY_FILE["infer/batching.py"] == {
+        "step", "static_batched_generate",
+    }
+    # the cache/page layer gets NO sanctions and NO waivers
+    assert "infer/kvcache.py" not in _SANCTIONED_BY_FILE
+    assert not any(path.startswith("infer/") for path, _ in _WAIVED)
 
 
 def test_remat_and_memory_ledger_are_scanned():

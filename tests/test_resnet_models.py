@@ -11,6 +11,21 @@ import torch.nn as nn
 
 from beforeholiday_tpu.models import resnet
 
+# jax >= 0.6 spells varying-axis-tracking-off jax.shard_map(check_vma=False);
+# older jax ships the experimental module with check_rep — same shim as
+# test_data_parallel.py so the suite runs on either
+_shard_map = getattr(jax, "shard_map", None)
+_CHECK_KW = "check_vma"
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def _smap(f, **kw):
+    kw[_CHECK_KW] = False
+    return _shard_map(f, **kw)
+
 
 class TorchBasicBlock(nn.Module):
     def __init__(self, cin, cout, stride):
@@ -148,9 +163,8 @@ class TestArchitecture:
         mesh = Mesh(np.asarray(devices8).reshape(8), ("data",))
 
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            _smap, mesh=mesh,
             in_specs=(P(), P(), P("data")), out_specs=(P("data"), P()),
-            check_vma=False,
         )
         def f(p, s, xs):
             return resnet.forward(p, s, xs, cfg, training=True, axis_name="data")
