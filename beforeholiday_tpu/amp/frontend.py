@@ -141,7 +141,8 @@ class AmpModel:
         if not self.scalers:
             self.scalers = (self.scaler,)
 
-    def state_dict(self, scaler_state, metrics=None) -> Dict[str, Any]:
+    def state_dict(self, scaler_state, metrics=None,
+                   optimizer_state=None) -> Dict[str, Any]:
         """Scaler checkpoint (ref: apex/amp/frontend.py:434-452 amp.state_dict
         — one ``loss_scaler{i}`` entry per loss). ``scaler_state`` is the
         single state, or a sequence of per-loss states when num_losses > 1.
@@ -156,7 +157,14 @@ class AmpModel:
         ``metrics`` optionally takes the :mod:`beforeholiday_tpu.monitor`
         ``Metrics`` pytree; it serializes under a single ``"monitor"`` entry
         (EMAs and counters survive restarts). Old loaders ignore the extra
-        key, so checkpoints stay readable both ways."""
+        key, so checkpoints stay readable both ways.
+
+        ``optimizer_state`` optionally rides along under a single
+        ``"optimizer"`` entry, stored verbatim — pass the distributed
+        optimizer's own ``state_dict(...)`` result (e.g. ``ZeRO3FusedAdam``'s
+        gathered trees, or its ``gather_on_root=False`` shard next to a
+        ``zero3.shard_manifest``). Recover it with
+        :meth:`load_optimizer_state`; scaler-only loaders ignore the key."""
         states = (
             list(scaler_state)
             if isinstance(scaler_state, (list, tuple))
@@ -179,6 +187,8 @@ class AmpModel:
                     else float(v))
                 for k, v in metrics.items()
             }
+        if optimizer_state is not None:
+            out["optimizer"] = optimizer_state
         return out
 
     def load_state_dict(self, state_dict):
@@ -199,6 +209,15 @@ class AmpModel:
             else:
                 out.append(sstate)
         return out[0] if len(out) == 1 else out
+
+    def load_optimizer_state(self, state_dict):
+        """Recover the ``"optimizer"`` entry saved by
+        ``state_dict(..., optimizer_state=...)``, or None for checkpoints
+        without one. The value is whatever the optimizer's own
+        ``state_dict`` produced — feed it back through that optimizer's
+        ``load_state_dict`` (resharding first via ``zero3.reshard_state``
+        when the topology changed)."""
+        return state_dict.get("optimizer")
 
     def load_metrics(self, state_dict, monitor=None):
         """Restore the monitor ``Metrics`` pytree saved by

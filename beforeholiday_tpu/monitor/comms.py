@@ -29,7 +29,7 @@ Contract — what a record means:
 Query like ``dispatch_summary()``: ``comms_records()`` is the per-key
 snapshot, ``comms_summary()`` rolls up by subsystem (the site tag's prefix
 before the first ``.`` — ``ddp``/``tp``/``sp``/``pp``/``cp``/``zero2``/
-``sync_bn``), ``reset_comms_ledger()`` clears between entry points.
+``zero3``/``sync_bn``), ``reset_comms_ledger()`` clears between entry points.
 """
 
 from __future__ import annotations

@@ -15,6 +15,11 @@ from beforeholiday_tpu.optimizers.fused import (  # noqa: F401
     FusedSGD,
     supports_flat_step,
 )
+from beforeholiday_tpu.optimizers.zero3 import (  # noqa: F401
+    Zero3Layout,
+    ZeRO3FusedAdam,
+    ZeRO3FusedLAMB,
+)
 
 __all__ = [
     "DistributedFusedAdam",
@@ -27,4 +32,7 @@ __all__ = [
     "supports_flat_step",
     "FusedNovoGrad",
     "FusedSGD",
+    "Zero3Layout",
+    "ZeRO3FusedAdam",
+    "ZeRO3FusedLAMB",
 ]

@@ -59,6 +59,10 @@ def _pad_to(flat: jax.Array, n: int) -> jax.Array:
 class _DistributedFused:
     """Shared arena/collective machinery for the sharded optimizers."""
 
+    # comms-ledger site prefix; ``comms_summary`` rolls sites up by this, so
+    # the ZeRO-3 subclass reports under ``zero3.*`` with the same machinery
+    _site_prefix = "zero2"
+
     def __init__(
         self,
         *,
@@ -98,7 +102,8 @@ class _DistributedFused:
         """all_gather a state shard back into full per-tensor pieces — the one
         inverse used by _gather_params/state_dict."""
         full = comms.all_gather(shard_arr, self.axis_name,
-                                site="zero2.gather_state", axis=0, tiled=True)
+                                site=f"{self._site_prefix}.gather_state",
+                                axis=0, tiled=True)
         return unflatten(full[: spec.padded_total], spec)
 
     def init(self, params):
@@ -134,7 +139,8 @@ class _DistributedFused:
             # the moment that bucket's reduce-scatter lands — the geometry
             # is bucket_slices(shard, 4 * world, bucket_bytes), fp32 arena
             chunks = bucketing.bucketed_psum_scatter(
-                gflat, self.axis_name, site="zero2.reduce_scatter_grads",
+                gflat, self.axis_name,
+                site=f"{self._site_prefix}.reduce_scatter_grads",
                 bucket_bytes=self.bucket_bytes, compress=self.compress,
                 wire_dtype=self.wire_dtype, concat=False,
             )
@@ -142,7 +148,8 @@ class _DistributedFused:
                 chunks = [c / self._world() for c in chunks]
             return chunks
         g_shard = bucketing.bucketed_psum_scatter(
-            gflat, self.axis_name, site="zero2.reduce_scatter_grads",
+            gflat, self.axis_name,
+            site=f"{self._site_prefix}.reduce_scatter_grads",
             bucket_bytes=self.bucket_bytes, compress=self.compress,
             wire_dtype=self.wire_dtype,
         )
@@ -167,7 +174,8 @@ class _DistributedFused:
                 wire = master_shard.astype(self.wire_dtype)
                 logical_dtype = master_shard.dtype
             full = bucketing.bucketed_all_gather(
-                wire, self.axis_name, site="zero2.gather_params",
+                wire, self.axis_name,
+                site=f"{self._site_prefix}.gather_params",
                 bucket_bytes=self.bucket_bytes, logical_dtype=logical_dtype,
             )
             pieces = unflatten(full[: spec.padded_total], spec)
@@ -185,7 +193,7 @@ class _DistributedFused:
             local_bad | (jnp.asarray(found_inf) != 0)
         )
         return comms.pmax(flag.astype(jnp.float32), self.axis_name,
-                          site="zero2.found_inf") != 0
+                          site=f"{self._site_prefix}.found_inf") != 0
 
     # -- checkpointing (ref: distributed_fused_adam.py:1123-1150
     # ``state_dict(gather_on_root=True)`` + ``load_state_dict``) --------------
@@ -302,7 +310,7 @@ class DistributedFusedAdam(_DistributedFused):
         if found_inf is not None:
             local_bad = local_bad | (jnp.asarray(found_inf) != 0)
         flag = comms.pmax(local_bad.astype(jnp.float32), self.axis_name,
-                          site="zero2.found_inf") != 0
+                          site=f"{self._site_prefix}.found_inf") != 0
         step_no = jnp.where(flag, state["step"], state["step"] + 1)
 
         # state slices share the grad chunks' geometry: the fp32 (shard,)

@@ -30,6 +30,12 @@ Built-in policies:
                          probs, gelu inputs) are recomputed from cheap saved
                          boundaries.
 
+* ``"zero3_regather"`` — param-residency knob for the ZeRO-3 engine: save
+                         everything EXCEPT values tagged ``zero3_gathered``
+                         (the all-gathered param leaves), so backward
+                         re-gathers params instead of keeping the full
+                         arena resident between forward and backward.
+
 ``register_policy`` adds new named policies (e.g. a model-specific tag set);
 ``apply(fn, policy)`` wraps a function for use under ``lax.scan`` or a
 pipeline stage slot.
@@ -49,6 +55,7 @@ __all__ = [
     "TAG_BLOCK",
     "TAG_FLASH_LSE",
     "TAG_NORM_OUT",
+    "ZERO3_GATHERED_TAG",
     "apply",
     "available_policies",
     "register_policy",
@@ -66,6 +73,12 @@ TAG_FLASH_LSE = "remat.flash_lse"  # flash-attention log-sum-exp residual
 BOUNDARY_TAGS: Tuple[str, ...] = (
     TAG_BLOCK, TAG_NORM_OUT, TAG_ATTN_OUT, TAG_FLASH_LSE,
 )
+
+# ZeRO-3 param residency: ``optimizers.zero3`` tags every all-gathered param
+# leaf with this name, so the ``"zero3_regather"`` policy below can make
+# gathered params NON-saveable — backward re-runs the bucketed all-gather
+# instead of holding the full-precision param copy across forward+backward
+ZERO3_GATHERED_TAG = "zero3_gathered"
 
 # sentinel for "do not wrap at all" — distinct from jax.checkpoint(policy=None)
 # which means "save nothing"
@@ -147,4 +160,11 @@ register_policy("dots_saveable", jax.checkpoint_policies.dots_saveable)
 register_policy(
     "save_boundaries",
     jax.checkpoint_policies.save_only_these_names(*BOUNDARY_TAGS),
+)
+register_policy(
+    # everything EXCEPT the gathered param arena is saveable: normal
+    # activation residency, but params are re-gathered in backward — the
+    # FSDP ``reshard_after_forward`` residency knob as a remat policy
+    "zero3_regather",
+    jax.checkpoint_policies.save_any_names_but_these(ZERO3_GATHERED_TAG),
 )

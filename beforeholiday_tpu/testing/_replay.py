@@ -1,0 +1,217 @@
+"""Deterministic dual-engine jaxpr replay — the CI-host overlap proxy.
+
+The 1-core CI host cannot measure real collective/compute overlap (one
+thread pool executes everything serially), so the benches derive their gated
+numbers from the one thing an overlap mechanism actually changes: WHERE the
+collectives sit in the traced program. A function is traced to a jaxpr and
+replayed through two in-order engines — compute ops on one, collectives on
+the other — each op starting at ``max(inputs ready, engine free)`` with
+fixed per-flop/per-byte costs. A collective issued mid-backward overlaps the
+remaining backward compute; a post-sweep collective serializes after it.
+Makespans are exact integers-in-disguise (no clocks, no noise), so ratios
+sit safely inside bench.py's ±10% stability gate.
+
+Shared by ``overlap_engine_bench`` (DDP hooks, optimizer-in-backward) and
+``zero3_bench`` (prefetched param all-gather). ``optimization_barrier`` is
+modeled as a zero-cost dependency join — it shapes the dataflow (the ZeRO-3
+prefetch depth chain) but burns neither engine's time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "COLLECTIVES",
+    "Engines",
+    "bitwise_equal",
+    "replay",
+    "replay_fn",
+]
+
+# replay cost model (arbitrary but FIXED units — paired variants share them,
+# and only ratios are gated): compute pays per output byte (elementwise) or
+# per flop (dot_general), the wire pays per byte plus a launch latency that
+# keeps many tiny collectives from being free
+FLOP_US = 1e-3
+MEM_US = 5e-4
+WIRE_US = 4e-3
+WIRE_LAT_US = 2.0
+MIN_US = 1e-3
+
+COLLECTIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "all_gather", "psum_scatter",
+    "all_to_all", "reduce_scatter", "all_gather_invariant", "pbroadcast",
+})
+
+
+class Engines:
+    """Two in-order engines plus the Perfetto-style event tape."""
+
+    __slots__ = ("t_compute", "t_comms", "events")
+
+    def __init__(self):
+        self.t_compute = 0.0
+        self.t_comms = 0.0
+        self.events: List[Dict[str, Any]] = []
+
+    def run(self, kind: str, name: str, ready: float, dur: float) -> float:
+        if kind == "comms":
+            start = max(ready, self.t_comms)
+            end = start + max(dur, MIN_US)
+            self.events.append(
+                {"ph": "B", "name": name, "pid": 0, "tid": 1, "ts": start})
+            self.events.append({"ph": "E", "pid": 0, "tid": 1, "ts": end})
+            self.t_comms = end
+        else:
+            start = max(ready, self.t_compute)
+            end = start + max(dur, MIN_US)
+            self.events.append(
+                {"ph": "B", "name": "compute", "pid": 0, "tid": 0,
+                 "ts": start})
+            self.events.append({"ph": "E", "pid": 0, "tid": 0, "ts": end})
+            self.t_compute = end
+        return end
+
+    def makespan(self) -> float:
+        return max(self.t_compute, self.t_comms)
+
+
+def _out_bytes(eqn) -> float:
+    total = 0
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "size"):
+            total += aval.size * jnp.dtype(aval.dtype).itemsize
+    return float(total)
+
+
+def _dot_flops(eqn) -> float:
+    (lc, _rc), (lb, _rb) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval
+    rhs = eqn.invars[1].aval
+    csize = 1
+    for d in lc:
+        csize *= lhs.shape[d]
+    bsize = 1
+    for d in lb:
+        bsize *= lhs.shape[d]
+    m = lhs.size // max(csize * bsize, 1)
+    n = rhs.size // max(csize * bsize, 1)
+    return 2.0 * bsize * m * n * csize
+
+
+def _sub_jaxpr(eqn):
+    """The inlineable sub-jaxpr of a call-like eqn (pjit / closed_call /
+    custom_vjp remnants / shard_map / remat), or None. Only taken when the
+    operand counts line up one-to-one, so a mismatched exotic primitive
+    falls back to the opaque-op cost instead of corrupting the env."""
+    for v in eqn.params.values():
+        inner = getattr(v, "jaxpr", None)
+        if inner is None and hasattr(v, "eqns") and hasattr(v, "invars"):
+            inner = v
+        if inner is None or not hasattr(inner, "eqns"):
+            continue
+        if len(inner.invars) == len(eqn.invars):
+            return inner
+    return None
+
+
+def replay(jaxpr, in_times: List[float], eng: Engines) -> List[float]:
+    """Program-order dual-engine replay of one (open) jaxpr."""
+    env: Dict[Any, float] = {}
+    for v, t in zip(jaxpr.invars, in_times):
+        env[v] = t
+    for v in jaxpr.constvars:
+        env[v] = 0.0
+
+    def get(v) -> float:
+        if hasattr(v, "val"):  # Literal
+            return 0.0
+        return env.get(v, 0.0)
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in ("while", "cond"):
+            raise RuntimeError(
+                f"replay does not model {name!r}; keep it out of bench models"
+            )
+        if name == "optimization_barrier":
+            # pure dependency join: outputs become ready when every input
+            # is, at zero engine cost — this is how the ZeRO-3 prefetch
+            # depth chain shapes the schedule without pretending the
+            # barrier itself does work
+            ready = max([get(v) for v in eqn.invars], default=0.0)
+            for v in eqn.outvars:
+                env[v] = ready
+            continue
+        if name == "scan":
+            body = eqn.params["jaxpr"].jaxpr
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            length = eqn.params["length"]
+            const_t = [get(v) for v in eqn.invars[:nc]]
+            carry_t = [get(v) for v in eqn.invars[nc:nc + ncar]]
+            xs_t = [get(v) for v in eqn.invars[nc + ncar:]]
+            ys_t: List[float] = [0.0] * (len(eqn.outvars) - ncar)
+            for _ in range(length):
+                outs = replay(body, const_t + carry_t + xs_t, eng)
+                carry_t = outs[:ncar]
+                ys_t = outs[ncar:]  # stacked ys ready at the last producer
+            for v, t in zip(eqn.outvars, carry_t + ys_t):
+                env[v] = t
+            continue
+        sub = _sub_jaxpr(eqn)
+        if sub is not None:
+            outs = replay(sub, [get(v) for v in eqn.invars], eng)
+            for v, t in zip(eqn.outvars, outs):
+                env[v] = t
+            continue
+        ready = max([get(v) for v in eqn.invars], default=0.0)
+        if name in COLLECTIVES:
+            dur = WIRE_LAT_US + _out_bytes(eqn) * WIRE_US
+            end = eng.run("comms", f"{name}:replay", ready, dur)
+        else:
+            if name == "dot_general":
+                dur = _dot_flops(eqn) * FLOP_US
+            else:
+                dur = _out_bytes(eqn) * MEM_US
+            end = eng.run("compute", "compute", ready, dur)
+        for v in eqn.outvars:
+            env[v] = end
+    return [get(v) for v in jaxpr.outvars]
+
+
+def replay_fn(fn, *args) -> Dict[str, Any]:
+    """Trace ``fn`` and replay it: makespan, events (with a wrapping step
+    span), and the achieved overlap_report fraction."""
+    from beforeholiday_tpu.monitor import overlap as mon_overlap
+
+    closed = jax.make_jaxpr(fn)(*args)
+    eng = Engines()
+    replay(closed.jaxpr, [0.0] * len(closed.jaxpr.invars), eng)
+    makespan = eng.makespan()
+    events = (
+        [{"ph": "B", "name": "step", "pid": 0, "tid": 2, "ts": 0.0}]
+        + eng.events
+        + [{"ph": "E", "pid": 0, "tid": 2, "ts": makespan}]
+    )
+    report = mon_overlap.overlap_report(events)
+    return {
+        "makespan_us": makespan,
+        "overlap_fraction": report["overlap_fraction"],
+        "comms_us": report["comms_us"],
+        "events": events,
+    }
+
+
+def bitwise_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )

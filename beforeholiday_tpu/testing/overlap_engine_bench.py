@@ -71,171 +71,12 @@ def _shmap(f, **kw):
 
 WORLD = 8
 
-# replay cost model (arbitrary but FIXED units — both variants of a pair
-# share them, and only ratios are gated): compute pays per output byte
-# (elementwise) or per flop (dot_general), the wire pays per byte plus a
-# launch latency that keeps many tiny collectives from being free
-_FLOP_US = 1e-3
-_MEM_US = 5e-4
-_WIRE_US = 4e-3
-_WIRE_LAT_US = 2.0
-_MIN_US = 1e-3
-
-_COLLECTIVES = frozenset({
-    "psum", "pmax", "pmin", "ppermute", "all_gather", "psum_scatter",
-    "all_to_all", "reduce_scatter", "all_gather_invariant", "pbroadcast",
-})
-
-
-class _Engines:
-    """Two in-order engines plus the Perfetto-style event tape."""
-
-    __slots__ = ("t_compute", "t_comms", "events")
-
-    def __init__(self):
-        self.t_compute = 0.0
-        self.t_comms = 0.0
-        self.events: List[Dict[str, Any]] = []
-
-    def run(self, kind: str, name: str, ready: float, dur: float) -> float:
-        if kind == "comms":
-            start = max(ready, self.t_comms)
-            end = start + max(dur, _MIN_US)
-            self.events.append(
-                {"ph": "B", "name": name, "pid": 0, "tid": 1, "ts": start})
-            self.events.append({"ph": "E", "pid": 0, "tid": 1, "ts": end})
-            self.t_comms = end
-        else:
-            start = max(ready, self.t_compute)
-            end = start + max(dur, _MIN_US)
-            self.events.append(
-                {"ph": "B", "name": "compute", "pid": 0, "tid": 0,
-                 "ts": start})
-            self.events.append({"ph": "E", "pid": 0, "tid": 0, "ts": end})
-            self.t_compute = end
-        return end
-
-    def makespan(self) -> float:
-        return max(self.t_compute, self.t_comms)
-
-
-def _out_bytes(eqn) -> float:
-    total = 0
-    for v in eqn.outvars:
-        aval = getattr(v, "aval", None)
-        if aval is not None and hasattr(aval, "size"):
-            total += aval.size * jnp.dtype(aval.dtype).itemsize
-    return float(total)
-
-
-def _dot_flops(eqn) -> float:
-    (lc, _rc), (lb, _rb) = eqn.params["dimension_numbers"]
-    lhs = eqn.invars[0].aval
-    rhs = eqn.invars[1].aval
-    csize = 1
-    for d in lc:
-        csize *= lhs.shape[d]
-    bsize = 1
-    for d in lb:
-        bsize *= lhs.shape[d]
-    m = lhs.size // max(csize * bsize, 1)
-    n = rhs.size // max(csize * bsize, 1)
-    return 2.0 * bsize * m * n * csize
-
-
-def _sub_jaxpr(eqn):
-    """The inlineable sub-jaxpr of a call-like eqn (pjit / closed_call /
-    custom_vjp remnants / shard_map / remat), or None. Only taken when the
-    operand counts line up one-to-one, so a mismatched exotic primitive
-    falls back to the opaque-op cost instead of corrupting the env."""
-    for v in eqn.params.values():
-        inner = getattr(v, "jaxpr", None)
-        if inner is None and hasattr(v, "eqns") and hasattr(v, "invars"):
-            inner = v
-        if inner is None or not hasattr(inner, "eqns"):
-            continue
-        if len(inner.invars) == len(eqn.invars):
-            return inner
-    return None
-
-
-def _replay(jaxpr, in_times: List[float], eng: _Engines) -> List[float]:
-    """Program-order dual-engine replay of one (open) jaxpr."""
-    env: Dict[Any, float] = {}
-    for v, t in zip(jaxpr.invars, in_times):
-        env[v] = t
-    for v in jaxpr.constvars:
-        env[v] = 0.0
-
-    def get(v) -> float:
-        if hasattr(v, "val"):  # Literal
-            return 0.0
-        return env.get(v, 0.0)
-
-    for eqn in jaxpr.eqns:
-        name = eqn.primitive.name
-        if name in ("while", "cond"):
-            raise RuntimeError(
-                f"replay does not model {name!r}; keep it out of bench models"
-            )
-        if name == "scan":
-            body = eqn.params["jaxpr"].jaxpr
-            nc = eqn.params["num_consts"]
-            ncar = eqn.params["num_carry"]
-            length = eqn.params["length"]
-            const_t = [get(v) for v in eqn.invars[:nc]]
-            carry_t = [get(v) for v in eqn.invars[nc:nc + ncar]]
-            xs_t = [get(v) for v in eqn.invars[nc + ncar:]]
-            ys_t: List[float] = [0.0] * (len(eqn.outvars) - ncar)
-            for _ in range(length):
-                outs = _replay(body, const_t + carry_t + xs_t, eng)
-                carry_t = outs[:ncar]
-                ys_t = outs[ncar:]  # stacked ys ready at the last producer
-            for v, t in zip(eqn.outvars, carry_t + ys_t):
-                env[v] = t
-            continue
-        sub = _sub_jaxpr(eqn)
-        if sub is not None:
-            outs = _replay(sub, [get(v) for v in eqn.invars], eng)
-            for v, t in zip(eqn.outvars, outs):
-                env[v] = t
-            continue
-        ready = max([get(v) for v in eqn.invars], default=0.0)
-        if name in _COLLECTIVES:
-            dur = _WIRE_LAT_US + _out_bytes(eqn) * _WIRE_US
-            end = eng.run("comms", f"{name}:replay", ready, dur)
-        else:
-            if name == "dot_general":
-                dur = _dot_flops(eqn) * _FLOP_US
-            else:
-                dur = _out_bytes(eqn) * _MEM_US
-            end = eng.run("compute", "compute", ready, dur)
-        for v in eqn.outvars:
-            env[v] = end
-    return [get(v) for v in jaxpr.outvars]
-
-
-def _replay_fn(fn, *args) -> Dict[str, Any]:
-    """Trace ``fn`` and replay it: makespan, events (with a wrapping step
-    span), and the achieved overlap_report fraction."""
-    from beforeholiday_tpu.monitor import overlap as mon_overlap
-
-    closed = jax.make_jaxpr(fn)(*args)
-    eng = _Engines()
-    _replay(closed.jaxpr, [0.0] * len(closed.jaxpr.invars), eng)
-    makespan = eng.makespan()
-    events = (
-        [{"ph": "B", "name": "step", "pid": 0, "tid": 2, "ts": 0.0}]
-        + eng.events
-        + [{"ph": "E", "pid": 0, "tid": 2, "ts": makespan}]
-    )
-    report = mon_overlap.overlap_report(events)
-    return {
-        "makespan_us": makespan,
-        "overlap_fraction": report["overlap_fraction"],
-        "comms_us": report["comms_us"],
-        "events": events,
-    }
+# the dual-engine replay lives in testing/_replay (shared with zero3_bench);
+# these aliases keep this module's internal call sites unchanged
+from beforeholiday_tpu.testing._replay import (  # noqa: E402
+    bitwise_equal as _bitwise_equal,
+    replay_fn as _replay_fn,
+)
 
 
 def _time(fn, args, iters, rounds=3):
@@ -249,14 +90,6 @@ def _time(fn, args, iters, rounds=3):
         jax.block_until_ready(out)
         best = min(best, (time.perf_counter() - t0) / iters)
     return best
-
-
-def _bitwise_equal(a, b) -> bool:
-    la = jax.tree_util.tree_leaves(a)
-    lb = jax.tree_util.tree_leaves(b)
-    return len(la) == len(lb) and all(
-        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
-    )
 
 
 def main(quick: bool = False):
