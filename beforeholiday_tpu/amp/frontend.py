@@ -37,7 +37,11 @@ import jax
 import jax.numpy as jnp
 
 from beforeholiday_tpu.amp.scaler import LossScaler
-from beforeholiday_tpu.ops._autocast import autocast, cast_floats as _cast_floats
+from beforeholiday_tpu.ops._autocast import (
+    autocast,
+    cast_floats as _cast_floats,
+    quantized_compute,
+)
 from beforeholiday_tpu.ops.arena import PackedParams
 from beforeholiday_tpu.optimizers.fused import MasterWeights
 from beforeholiday_tpu.utils.logging import get_logger
@@ -57,6 +61,7 @@ class Properties:
     keep_batchnorm_fp32: Optional[bool] = None
     master_weights: Optional[bool] = None
     loss_scale: Any = 1.0  # "dynamic" | float
+    quantized: bool = False  # O6: fp8-quantized matmuls under delayed scaling
 
     @property
     def compute_dtype(self):
@@ -82,6 +87,13 @@ opt_levels: Dict[str, Properties] = {
                      patch_torch_functions_type=jnp.bfloat16, loss_scale=1.0),
     "O5": Properties(opt_level="O5", cast_model_type=jnp.bfloat16,
                      keep_batchnorm_fp32=True, master_weights=True, loss_scale=1.0),
+    # O6 = O5's storage policy + fp8-quantized GEMMs (ops.quantized). The loss
+    # scale is dynamic: e5m2 grad quantization signals overflow by saturating
+    # to inf, and the dynamic scaler's skip/halve loop is the recovery path —
+    # the amax history for the delayed scales rides inside the scaler state.
+    "O6": Properties(opt_level="O6", cast_model_type=jnp.bfloat16,
+                     keep_batchnorm_fp32=True, master_weights=True,
+                     loss_scale="dynamic", quantized=True),
 }
 
 
@@ -286,7 +298,7 @@ def initialize(
     if opt_level not in opt_levels:
         raise RuntimeError(
             f"Unexpected optimization level {opt_level}. Options are 'O0', 'O1', "
-            "'O2', 'O3', 'O4', 'O5'."
+            "'O2', 'O3', 'O4', 'O5', 'O6'."
         )
     policy = opt_levels[opt_level]
     overrides = {}
@@ -332,7 +344,10 @@ def initialize(
 
     if num_losses < 1:
         raise ValueError(f"num_losses must be >= 1, got {num_losses}")
-    scalers = tuple(LossScaler(loss_scale=policy.loss_scale) for _ in range(num_losses))
+    scalers = tuple(
+        LossScaler(loss_scale=policy.loss_scale, quantized=policy.quantized)
+        for _ in range(num_losses)
+    )
     return AmpModel(
         policy=policy, apply=amp_apply, params=cast_params,
         optimizer=opt, scaler=scalers[0], scalers=scalers,
@@ -381,7 +396,12 @@ def make_apply(
             # (dense/mlp/attention) stay low-precision — the reference's
             # FP32_FUNCS / FP16_FUNCS split (functional_overrides.py:17-91)
             p = _cast_params_keep_norms(p)
-            scope = autocast(compute_dtype)
+            scope = autocast(compute_dtype, quantized=policy.quantized)
+        elif policy.quantized:
+            # O6: O5's storage-cast semantics, but every ops.dense matmul
+            # routes through the fp8 tier — no per-op cast policy, the scope
+            # only flips the quantized-routing predicate (jit-cache-keyed)
+            scope = quantized_compute()
         else:
             scope = contextlib.nullcontext()
         inputs = _cast_floats(inputs, compute_dtype)
@@ -426,11 +446,32 @@ def scaled_value_and_grad(
             loss, aux = res if has_aux else (res, None)
             return scaler.scale_loss(loss, scaler_state), (loss, aux)
 
-        grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(params)
+        # O6: derive this step's delayed fp8 scales from the amax history in
+        # the scaler state and expose them to every quantized_matmul in the
+        # trace (scope values are step-level tracers; closures inside
+        # scan/grad capture them legally — nothing escapes a trace)
+        scale_w, scale_g = scaler.quantized_scales(scaler_state)
+        if scale_w is not None:
+            from beforeholiday_tpu.ops.quantized import quantized_scope
+
+            q_scope = quantized_scope(scale_w, scale_g)
+        else:
+            q_scope = contextlib.nullcontext()
+        with q_scope:
+            grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(params)
         if reduce_grads is not None:
             grads = reduce_grads(grads)
+        amax = None
+        if scale_w is not None:
+            from beforeholiday_tpu.ops.quantized import amax_of_tree
+
+            # weight row: params ARE the tensors the forward quantized
+            # (exact); grad row: the still-scaled grads live in the same
+            # scaling regime the backward quantized its cotangents in — a
+            # conservative per-step proxy for the dy amax
+            amax = (amax_of_tree(params), amax_of_tree(grads))
         grads, found_inf = scaler.unscale(grads, scaler_state, impl=impl)
-        new_state = scaler.update(scaler_state, found_inf)
+        new_state = scaler.update(scaler_state, found_inf, amax=amax)
         if has_aux:
             return loss, aux, grads, found_inf, new_state
         return loss, grads, found_inf, new_state

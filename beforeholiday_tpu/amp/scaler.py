@@ -35,6 +35,13 @@ class LossScaler:
     scale_window: int = 2000
     min_loss_scale: Optional[float] = None
     max_loss_scale: float = 2.0**24
+    # O6: carry the fp8 delayed-scaling amax history (ops.quantized) inside
+    # this state pytree — one rolling row per HISTORY_ROLES entry — so the
+    # quantization scales ride the exact same skip/rollback/checkpoint
+    # machinery (StepGuard snapshots, state_dict) as the loss scale itself.
+    quantized: bool = False
+    amax_history_len: int = 16
+    amax_margin: float = 2.0
 
     @property
     def dynamic(self) -> bool:
@@ -42,11 +49,16 @@ class LossScaler:
 
     def init(self) -> Dict[str, jax.Array]:
         scale = self.init_scale if self.dynamic else float(self.loss_scale)
-        return {
+        state = {
             "scale": jnp.float32(scale),
             "unskipped": jnp.int32(0),
             "consecutive_overflows": jnp.int32(0),
         }
+        if self.quantized:
+            from beforeholiday_tpu.ops.quantized import init_amax_history
+
+            state["amax_history"] = init_amax_history(self.amax_history_len)
+        return state
 
     def at_min_scale(self, state) -> jax.Array:
         """True when the scale cannot shrink further — the reference halves
@@ -88,7 +100,19 @@ class LossScaler:
             found = found | flag
         return jax.tree_util.tree_unflatten(treedef, out), found
 
-    def update(self, state, found_inf) -> Dict[str, jax.Array]:
+    def quantized_scales(self, state):
+        """(scale_w, scale_g) for this step's :func:`ops.quantized
+        .quantized_scope`, derived from the state's amax history. States
+        without the key (or a non-quantized scaler) get (None, None)."""
+        if not (isinstance(state, dict) and "amax_history" in state):
+            return None, None
+        from beforeholiday_tpu.ops.quantized import scales_from_history
+
+        return scales_from_history(
+            state["amax_history"], margin=self.amax_margin
+        )
+
+    def update(self, state, found_inf, *, amax=None) -> Dict[str, jax.Array]:
         """Post-step scale update (ref: apex/amp/scaler.py:206-226).
 
         overflow → scale /= factor, counter reset; scale_window clean steps →
@@ -100,6 +124,12 @@ class LossScaler:
         and this counter is the visible evidence — the step guard's rollback
         keys off it together with :meth:`at_min_scale`. Old states without the
         key are tolerated (pre-guard checkpoints).
+
+        ``amax`` optionally rolls this step's (weight, grad) amax
+        observations into the fp8 delayed-scaling history (states carrying
+        ``"amax_history"`` only; non-finite observations are dropped inside
+        ``update_amax_history``, so an overflow step never poisons the
+        scales — it only trips the skip above).
         """
         skip = jnp.asarray(found_inf) != 0
         consec = jnp.where(
@@ -107,8 +137,15 @@ class LossScaler:
             state.get("consecutive_overflows", jnp.int32(0)) + 1,
             0,
         ).astype(jnp.int32)
+        extra = {}
+        if amax is not None and isinstance(state, dict) and "amax_history" in state:
+            from beforeholiday_tpu.ops.quantized import update_amax_history
+
+            extra["amax_history"] = update_amax_history(
+                state["amax_history"], amax[0], amax[1]
+            )
         if not self.dynamic:
-            return {**state, "consecutive_overflows": consec}
+            return {**state, "consecutive_overflows": consec, **extra}
         scale, unskipped = state["scale"], state["unskipped"]
 
         shrunk = scale / self.scale_factor
@@ -121,29 +158,50 @@ class LossScaler:
         new_scale = jnp.where(skip, shrunk, jnp.where(grow, grown, scale))
         new_unskipped = jnp.where(grow, 0, unskipped_next)
         return {
+            **{k: v for k, v in state.items()},
             "scale": new_scale,
             "unskipped": new_unskipped,
             "consecutive_overflows": consec,
+            **extra,
         }
 
     # --- checkpointing (ref: apex/amp/frontend.py:434-473) ----------------------
 
     def state_dict(self, state) -> Dict[str, Any]:
-        return {
+        out = {
             "loss_scale": float(state["scale"]),
             "unskipped": int(state["unskipped"]),
             "consecutive_overflows": int(
                 state.get("consecutive_overflows", 0)
             ),
         }
+        if isinstance(state, dict) and "amax_history" in state:
+            # JSON-ready nested lists; pre-O6 loaders ignore the extra key
+            import numpy as _np
+
+            out["amax_history"] = _np.asarray(
+                state["amax_history"], dtype=_np.float32
+            ).tolist()
+        return out
 
     def load_state_dict(self, state_dict) -> Dict[str, jax.Array]:
         # accept pre-guard dicts without the counter — checkpoints round-trip
         # across the schema change in both directions
-        return {
+        out = {
             "scale": jnp.float32(state_dict["loss_scale"]),
             "unskipped": jnp.int32(state_dict["unskipped"]),
             "consecutive_overflows": jnp.int32(
                 state_dict.get("consecutive_overflows", 0)
             ),
         }
+        if "amax_history" in state_dict:
+            out["amax_history"] = jnp.asarray(
+                state_dict["amax_history"], jnp.float32
+            )
+        elif self.quantized:
+            # pre-O6 checkpoint into a quantized scaler: fresh history, the
+            # delayed scales re-warm from just-in-time fallbacks in one window
+            from beforeholiday_tpu.ops.quantized import init_amax_history
+
+            out["amax_history"] = init_amax_history(self.amax_history_len)
+        return out

@@ -182,6 +182,34 @@ def _probe(op_name: str, fn: Callable, args: tuple, kw: dict) -> None:
         jax.eval_shape(probe_fn, *structs)
 
 
+def _key_of(op_name: str, args: tuple, kw: dict, statics: Tuple) -> Tuple:
+    sig = lambda a: (a.shape, str(a.dtype)) if _is_arrayish(a) else repr(a)
+    return (
+        op_name,
+        jax.default_backend(),
+        tuple(sig(a) for a in args),
+        tuple(sorted((k, sig(v)) for k, v in kw.items())),
+        tuple(repr(s) for s in statics),
+    )
+
+
+def count_forced(
+    op_name: str,
+    impl: str,
+    *args: Any,
+    statics: Tuple = (),
+    **kw: Any,
+) -> None:
+    """Book a dispatch that BYPASSED the probe under the same counter-key
+    shape as :func:`checked_impl` — for ops with no viable oracle at this
+    shape (e.g. flash attention backward at S=8192, where materializing the
+    jnp scores is uncompilable), where degradation would be worse than
+    failing loudly. Telemetry only: no probe, no verdict, no downgrade."""
+    key = _key_of(op_name, args, kw, statics)
+    with _VERDICTS_LOCK:
+        _count(key, impl)
+
+
 def checked_impl(
     op_name: str,
     impl: str,
@@ -200,14 +228,7 @@ def checked_impl(
     """
     if impl != "pallas":
         return impl
-    sig = lambda a: (a.shape, str(a.dtype)) if _is_arrayish(a) else repr(a)
-    key = (
-        op_name,
-        jax.default_backend(),
-        tuple(sig(a) for a in args),
-        tuple(sorted((k, sig(v)) for k, v in kw.items())),
-        tuple(repr(s) for s in statics),
-    )
+    key = _key_of(op_name, args, kw, statics)
     with _VERDICTS_LOCK:
         if key in _VERDICTS:
             chosen = "jnp" if _VERDICTS[key] is not None else "pallas"

@@ -174,14 +174,35 @@ class StepGuard:
                 loss, aux = res if has_aux else (res, None)
                 return self.scaler.scale_loss(loss, sstate), (loss, aux)
 
-            grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(params)
+            # O6: thread the delayed fp8 scales from the scaler state into
+            # the trace (see amp.scaled_value_and_grad — same fold, guard
+            # flavor); the step's amax observations ride the verdict into
+            # apply_update, which owns the scale/history update
+            scale_w, scale_g = self.scaler.quantized_scales(sstate)
+            if scale_w is not None:
+                from beforeholiday_tpu.ops.quantized import quantized_scope
+
+                q_scope = quantized_scope(scale_w, scale_g)
+            else:
+                import contextlib
+
+                q_scope = contextlib.nullcontext()
+            with q_scope:
+                grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
+                    params
+                )
             if reduce_grads is not None:
                 grads = reduce_grads(grads)
+            verdict = {}
+            if scale_w is not None:
+                from beforeholiday_tpu.ops.quantized import amax_of_tree
+
+                verdict["amax"] = (amax_of_tree(params), amax_of_tree(grads))
             grads, grad_inf = self.scaler.unscale(grads, sstate, impl=impl)
-            verdict = {
+            verdict.update({
                 "grad_overflow": jnp.asarray(grad_inf) != 0,
                 "loss_nonfinite": _tree_nonfinite(loss),
-            }
+            })
             if has_aux:
                 return loss, aux, grads, verdict
             return loss, grads, verdict
@@ -251,7 +272,9 @@ class StepGuard:
             new_opt_state = _tree_select(param_bad, opt_state, new_opt_state)
         skip = pre_inf | param_bad
 
-        sstate = self.scaler.update(gstate["scaler"], skip)
+        sstate = self.scaler.update(
+            gstate["scaler"], skip, amax=verdict.get("amax")
+        )
         consec = sstate.get(
             "consecutive_overflows",
             jnp.where(skip, gstate["health"]["consecutive_overflows"] + 1, 0),

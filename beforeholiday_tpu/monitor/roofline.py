@@ -80,17 +80,30 @@ __all__ = [
 class ChipSpec:
     """Peak numbers utilization is measured against. ``peak_tflops`` is the
     dense-matmul peak for the dtype you train in (the 172.6 TFLOP/s the bench
-    roofline uses is bf16); ``hbm_gbs`` is peak memory bandwidth in GB/s."""
+    roofline uses is bf16); ``hbm_gbs`` is peak memory bandwidth in GB/s.
+    ``fp8_peak_tflops`` is the quantized-matmul peak FLOPs booked as
+    ``fp8_flops`` are measured against (O6 GEMMs); None means the standard
+    2x-of-dense-peak MXU ratio."""
 
     name: str
     peak_tflops: float
     hbm_gbs: float
+    fp8_peak_tflops: Optional[float] = None
 
     @property
     def ridge_flops_per_byte(self) -> float:
         """Arithmetic intensity at which the roofline bends: entries above it
         are compute-bound, below it memory-bound."""
         return (self.peak_tflops * 1e12) / (self.hbm_gbs * 1e9)
+
+    @property
+    def fp8_peak(self) -> float:
+        """Effective fp8 peak in TFLOP/s (2x dense peak unless overridden)."""
+        return (
+            self.fp8_peak_tflops
+            if self.fp8_peak_tflops is not None
+            else 2.0 * self.peak_tflops
+        )
 
 
 _SPECS_LOCK = threading.Lock()
@@ -109,17 +122,21 @@ def register_chip_spec(
     name: Optional[str] = None,
     peak_tflops: Optional[float] = None,
     hbm_gbs: Optional[float] = None,
+    fp8_peak_tflops: Optional[float] = None,
 ) -> ChipSpec:
     """Register (or overwrite) a chip spec by name. Pass a :class:`ChipSpec`
-    or the three fields as keywords. Returns the registered spec."""
+    or the fields as keywords. Returns the registered spec."""
     if spec is None:
         if name is None or peak_tflops is None or hbm_gbs is None:
             raise ValueError(
                 "register_chip_spec needs a ChipSpec or all of "
                 "name/peak_tflops/hbm_gbs"
             )
-        spec = ChipSpec(str(name), float(peak_tflops), float(hbm_gbs))
-    if spec.peak_tflops <= 0 or spec.hbm_gbs <= 0:
+        spec = ChipSpec(
+            str(name), float(peak_tflops), float(hbm_gbs),
+            float(fp8_peak_tflops) if fp8_peak_tflops is not None else None,
+        )
+    if spec.peak_tflops <= 0 or spec.hbm_gbs <= 0 or spec.fp8_peak <= 0:
         raise ValueError(f"chip peaks must be positive, got {spec}")
     with _SPECS_LOCK:
         _CHIP_SPECS[spec.name] = spec
@@ -354,7 +371,7 @@ _LOCK = threading.Lock()
 #                                "first_call": int}},
 #           "calls": int, "seconds": float, "timed_steps": int,
 #           "comms_seconds": float, "flops_override": float|None,
-#           "bytes_override": float|None}
+#           "fp8_flops_override": float|None, "bytes_override": float|None}
 _ENTRIES: Dict[str, Dict[str, Any]] = {}
 
 
@@ -363,7 +380,8 @@ def _entry_row(entry: str) -> Dict[str, Any]:
     return _ENTRIES.setdefault(entry, {
         "signatures": {}, "calls": 0,
         "seconds": 0.0, "timed_steps": 0, "comms_seconds": 0.0,
-        "flops_override": None, "bytes_override": None,
+        "flops_override": None, "fp8_flops_override": None,
+        "bytes_override": None,
     })
 
 
@@ -444,6 +462,7 @@ def record_wall_time(
     *,
     steps: int = 1,
     flops: Optional[float] = None,
+    fp8_flops: Optional[float] = None,
     bytes_accessed: Optional[float] = None,
     comms_seconds: float = 0.0,
 ) -> None:
@@ -454,8 +473,12 @@ def record_wall_time(
     are optional PER-STEP overrides for callers that know the analytic count
     in closed form (the bench's 6·N·tokens); they take precedence over the
     tracked costs so the headline MFU matches the bench's own arithmetic.
-    ``comms_seconds`` (also per the whole measurement) feeds the comms-bound
-    classification. Host floats in, host floats stored — no device work."""
+    ``fp8_flops`` is the per-step share of ``flops``-class work executed as
+    quantized (fp8) matmuls — it is measured against the chip's fp8 peak in
+    the MFU, so pass the SPLIT (``flops`` excluding the fp8 share), not the
+    total twice. ``comms_seconds`` (also per the whole measurement) feeds the
+    comms-bound classification. Host floats in, host floats stored — no
+    device work."""
     if seconds < 0 or steps < 1:
         raise ValueError(f"need seconds >= 0 and steps >= 1, got "
                          f"{seconds}/{steps}")
@@ -466,6 +489,8 @@ def record_wall_time(
         row["comms_seconds"] += float(comms_seconds)
         if flops is not None:
             row["flops_override"] = float(flops)
+        if fp8_flops is not None:
+            row["fp8_flops_override"] = float(fp8_flops)
         if bytes_accessed is not None:
             row["bytes_override"] = float(bytes_accessed)
 
@@ -509,6 +534,7 @@ def roofline_records() -> Dict[str, Dict[str, Any]]:
                 "timed_steps": row["timed_steps"],
                 "comms_seconds": row["comms_seconds"],
                 "flops_override": row["flops_override"],
+                "fp8_flops_override": row["fp8_flops_override"],
                 "bytes_override": row["bytes_override"],
                 "signatures": [
                     dict(r["costs"]) if r["costs"] is not None else None
@@ -547,6 +573,8 @@ def roofline_summary(
             ]
             nbytes = max(sig_bytes, default=None)
 
+        fp8_flops = row["fp8_flops_override"]
+
         steps = row["timed_steps"]
         sec = row["seconds"] / steps if steps else None
         comms_frac = (
@@ -554,12 +582,22 @@ def roofline_summary(
         )
         mfu = None
         bw_util = None
-        if sec and flops is not None:
-            mfu = flops / sec / 1e12 / spec.peak_tflops
+        if sec and (flops is not None or fp8_flops is not None):
+            # each precision class utilizes its own peak: bf16-class flops
+            # against peak_tflops, quantized-GEMM flops against the fp8 peak
+            mfu = (
+                (flops or 0.0) / spec.peak_tflops
+                + (fp8_flops or 0.0) / spec.fp8_peak
+            ) / sec / 1e12
         if sec and nbytes is not None:
             bw_util = nbytes / sec / 1e9 / spec.hbm_gbs
+        total_flops = (
+            (flops or 0.0) + (fp8_flops or 0.0)
+            if flops is not None or fp8_flops is not None
+            else None
+        )
         intensity = (
-            flops / nbytes if flops is not None and nbytes else None
+            total_flops / nbytes if total_flops is not None and nbytes else None
         )
         if comms_frac is not None and comms_frac >= 0.5:
             bound = "comms"
@@ -573,6 +611,7 @@ def roofline_summary(
             "signatures": len(row["signatures"]),
             "method": method,
             "flops_per_step": flops,
+            "fp8_flops_per_step": fp8_flops,
             "bytes_per_step": nbytes,
             "seconds_per_step": sec,
             "timed_steps": steps,

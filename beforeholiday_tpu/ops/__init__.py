@@ -10,6 +10,8 @@
 * ``dense`` — fused dense / GELU-epilogue dense / whole-MLP chains
   (``fused_dense_cuda``, ``mlp_cuda``) — XLA-epilogue-fused by construction.
 * ``attention`` — Pallas flash attention (``fmhalib``, ``fast_multihead_attn``).
+* ``quantized`` — fp8-style quantized matmul with per-tensor delayed scaling
+  (the O6 tier; no reference equivalent — Transformer-Engine-shaped departure).
 """
 
 from .arena import (  # noqa: F401
@@ -55,4 +57,9 @@ from .attention import (  # noqa: F401
     flash_attention,
     is_flash_available,
     self_attention,
+)
+from .quantized import (  # noqa: F401
+    quantized_matmul,
+    quantized_matmul_error_bound,
+    quantized_scope,
 )

@@ -48,6 +48,17 @@ try:
         include_in_jit_key=True,
         include_in_trace_context=True,
     )
+    # O6's quantized-matmul routing flag must join the jit key exactly like
+    # the dtype: `jax.jit(fused_dense)` traced under O5 and re-called under O6
+    # would otherwise replay the unquantized cache entry.
+    _quantized_state = _jax_config.optional_enum_state(
+        name="beforeholiday_tpu_autocast_quantized",
+        enum_values=["on"],
+        default=None,
+        help="route fused matmuls through the fp8-style quantized path (O6)",
+        include_in_jit_key=True,
+        include_in_trace_context=True,
+    )
     _xla_metadata = None
 except Exception:
     # jax < 0.6: extra_jit_context is a FIXED NamedTuple — custom config
@@ -58,6 +69,7 @@ except Exception:
     # Side effect: ops traced inside autocast carry a frontend attribute —
     # metadata only, no semantic change.
     _dtype_state = None
+    _quantized_state = None
     try:
         from jax.experimental.xla_metadata import set_xla_metadata as _xla_metadata
     except Exception:  # pragma: no cover - future jax relocation
@@ -66,30 +78,64 @@ except Exception:
 
 class _State(threading.local):
     dtype: Optional[str] = None
+    quantized: bool = False
 
 
 _state = _State()
 
 
 @contextlib.contextmanager
-def autocast(dtype):
+def autocast(dtype, *, quantized: bool = False):
     """Activate the per-op cast policy with ``dtype`` as the low-precision
-    compute type (fp16 for O1, bf16 for O4)."""
+    compute type (fp16 for O1, bf16 for O4). ``quantized=True`` additionally
+    turns on O6's quantized-matmul routing for the scope (see
+    :func:`quantized_compute`)."""
     name = jnp.dtype(dtype).name
     if _dtype_state is not None:
         with _dtype_state(name):
-            yield
+            if quantized:
+                with _quantized_state("on"):
+                    yield
+            else:
+                yield
     else:
         prev = getattr(_state, "dtype", None)
+        prev_q = getattr(_state, "quantized", False)
         _state.dtype = name
+        _state.quantized = bool(quantized) or prev_q
         try:
             if _xla_metadata is not None:
-                with _xla_metadata(beforeholiday_tpu_autocast=name):
+                meta = name + (":q8" if _state.quantized else "")
+                with _xla_metadata(beforeholiday_tpu_autocast=meta):
                     yield
             else:
                 yield
         finally:
             _state.dtype = prev
+            _state.quantized = prev_q
+
+
+@contextlib.contextmanager
+def quantized_compute():
+    """Route every ``ops.dense`` matmul inside the scope through
+    ``ops.quantized.quantized_matmul`` (the O6 tier) WITHOUT activating the
+    per-op cast policy — O6 keeps O5's storage-cast semantics (bf16 params,
+    fp32 norms) and only swaps the GEMM arithmetic. Participates in the jit
+    cache key exactly like :func:`autocast`."""
+    if _quantized_state is not None:
+        with _quantized_state("on"):
+            yield
+    else:
+        prev_q = getattr(_state, "quantized", False)
+        _state.quantized = True
+        try:
+            if _xla_metadata is not None:
+                with _xla_metadata(beforeholiday_tpu_autocast_quantized="on"):
+                    yield
+            else:
+                yield
+        finally:
+            _state.quantized = prev_q
 
 
 def autocast_dtype() -> Optional[Any]:
@@ -99,6 +145,14 @@ def autocast_dtype() -> Optional[Any]:
     else:
         name = getattr(_state, "dtype", None)
     return jnp.dtype(name) if name else None
+
+
+def quantized_enabled() -> bool:
+    """True inside a :func:`quantized_compute` (or ``autocast(...,
+    quantized=True)``) scope — the O6 routing predicate ``ops.dense`` checks."""
+    if _quantized_state is not None:
+        return _quantized_state.value == "on"
+    return bool(getattr(_state, "quantized", False))
 
 
 def cast_floats(tree, dtype):

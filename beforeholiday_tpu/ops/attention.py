@@ -39,7 +39,10 @@ from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from beforeholiday_tpu.guard.dispatch import checked_impl as _checked_impl
+from beforeholiday_tpu.guard.dispatch import (
+    checked_impl as _checked_impl,
+    count_forced as _count_forced,
+)
 from beforeholiday_tpu.remat.policies import (
     TAG_ATTN_OUT as _TAG_ATTN_OUT,
     TAG_FLASH_LSE as _TAG_FLASH_LSE,
@@ -70,6 +73,31 @@ def _block_size(seq_len: int, head_dim: int = 64) -> int:
         if seq_len % cand == 0:
             return cand
     return _MIN_BLOCK
+
+
+# Above this many bytes of materialized (BH, S, Sk) fp32 scores the jnp
+# oracle stops being a viable degradation target: the unfused path holds the
+# score/probability tensors live through autodiff (several copies across
+# forward + backward), so "degrade to jnp" would trade a kernel bug for an
+# OOM. Past the budget the Pallas kernel is the ONLY dispatch path — no
+# probe, no downgrade, the dispatch is booked via ``count_forced`` so the
+# counters prove the oracle was never taken (e.g. the S=8192 backward rung).
+_ORACLE_SCORE_BYTES_CAP = 1 << 30  # 1 GiB
+
+
+def set_oracle_score_budget(nbytes: int) -> int:
+    """Set the max materialized-scores footprint (bytes of fp32 (BH, S, Sk))
+    at which the jnp oracle is still considered a viable fallback; returns
+    the previous budget. Unit tests shrink it to force the flash-only path
+    on small shapes."""
+    global _ORACLE_SCORE_BYTES_CAP
+    prev = _ORACLE_SCORE_BYTES_CAP
+    _ORACLE_SCORE_BYTES_CAP = int(nbytes)
+    return prev
+
+
+def oracle_score_budget() -> int:
+    return _ORACLE_SCORE_BYTES_CAP
 
 
 def is_flash_available(seq_len: int, head_dim: int) -> bool:
@@ -643,13 +671,23 @@ def flash_attention(
             else:
                 seed = jnp.zeros((1,), jnp.int32)
             if not forced:
-                # default-on dispatch is guarded; a forced impl='pallas'
-                # keeps the honor-or-raise contract above
-                impl = _checked_impl(
-                    "flash_attention", impl, _probe_flash_pallas,
-                    q3, k3, v3, lens_bh, seed,
-                    causal=causal, scale=scale, rate=float(dropout_rate),
-                )
+                if 4 * B * H * S * Sk > _ORACLE_SCORE_BYTES_CAP:
+                    # no viable oracle at this shape: the jnp fallback would
+                    # materialize > budget of fp32 scores through autodiff.
+                    # Flash is the only path — book it, skip probe/downgrade.
+                    _count_forced(
+                        "flash_attention", impl,
+                        q3, k3, v3, lens_bh, seed,
+                        causal=causal, scale=scale, rate=float(dropout_rate),
+                    )
+                else:
+                    # default-on dispatch is guarded; a forced impl='pallas'
+                    # keeps the honor-or-raise contract above
+                    impl = _checked_impl(
+                        "flash_attention", impl, _probe_flash_pallas,
+                        q3, k3, v3, lens_bh, seed,
+                        causal=causal, scale=scale, rate=float(dropout_rate),
+                    )
         if impl == "pallas":
             o = _flash3(q3, k3, v3, lens_bh, seed, causal, scale,
                         float(dropout_rate))

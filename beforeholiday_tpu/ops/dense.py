@@ -16,11 +16,19 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from beforeholiday_tpu.ops._autocast import half_function
+from beforeholiday_tpu.ops._autocast import half_function, quantized_enabled
 
 
 def _matmul(x, w):
-    # fp32 MXU accumulation regardless of input dtype
+    # fp32 MXU accumulation regardless of input dtype. Inside an O6
+    # quantized_compute scope the GEMM swaps to the fp8-operand path — same
+    # (..., K) @ (K, N) -> fp32 contract, so every fused wrapper below (and
+    # the GPT/BERT blocks built on them) inherits the tier with no signature
+    # change.
+    if quantized_enabled():
+        from beforeholiday_tpu.ops.quantized import quantized_matmul
+
+        return quantized_matmul(x, w)
     return jax.lax.dot_general(
         x, w, (((x.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )

@@ -1,0 +1,191 @@
+"""O6 quantized-tier rungs, oracle-checked and gated — on the CPU backend.
+
+Three claims from the O6 ISSUE, each pinned the only way the CI host allows
+(same philosophy as ``zero3_bench``):
+
+* **Loss parity within the exported analytic bound** — an O6 GPT train run
+  (fp8-style quantized block GEMMs, delayed scaling, StepGuard semantics) is
+  stepped >= 50 steps side-by-side with O5 from identical init/batches; at
+  EVERY step the loss deviation must sit inside
+  ``ops.quantized.loss_parity_bound`` (the per-matmul e4m3 relative-error
+  envelope composed across the quantized GEMMs, compounded per step).
+  Asserted before anything prints; the measured margin (max deviation /
+  bound) is emitted alongside so the bound's looseness is visible, not
+  hidden.
+* **Per-matmul error bound** — a raw ``quantized_matmul`` against its fp32
+  reference must land inside ``quantized_matmul_error_bound`` for the same
+  operands (the bound the parity envelope is built from).
+* **Dispatch honesty** — after the runs, the guard counters must show every
+  ``quantized_matmul`` dispatch on the native-fp8 fast path and ZERO oracle
+  downgrades, and the O6 scaler state must carry a populated amax history
+  (both rows nonzero) with no skipped steps.
+
+Everything here is deterministic (same seeds, same backend), so the gated
+keys — ``o6_loss_parity_margin`` and ``o6_vs_o5_final_loss_dev`` — re-derive
+exactly in ``pass2`` and sit safely inside the parent bench's ±10% gate.
+
+Run as ``python -m beforeholiday_tpu.testing.quantized_bench`` (``--quick``
+shrinks the step count) under ``JAX_PLATFORMS=cpu``; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _train_losses(opt_level: str, cfg, batch: int, steps: int):
+    """Loss trajectory + final scaler state for one opt level, fresh ledgers."""
+    from beforeholiday_tpu import amp
+    from beforeholiday_tpu.optimizers import FusedAdam
+    from beforeholiday_tpu.testing import gpt
+
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    tokens, targets = gpt.synthetic_batch(jax.random.PRNGKey(1), cfg, batch)
+    m = amp.initialize(
+        lambda p, t: gpt.forward(p, t, cfg), params,
+        FusedAdam(lr=1e-3), opt_level,
+    )
+
+    def loss_fn(p, tok, tgt):
+        return gpt.loss_fn(p, tok, tgt, cfg, forward_fn=m.apply)
+
+    svag = amp.scaled_value_and_grad(loss_fn, m.scaler)
+
+    @jax.jit
+    def step(p, o, sc, tok, tgt):
+        loss, g, fi, sc = svag(p, sc, tok, tgt)
+        p, o = m.optimizer.step(p, g, o, found_inf=fi)
+        return p, o, sc, loss, fi
+
+    p, o, sc = m.params, m.optimizer.init(m.params), m.scaler.init()
+    losses, skipped = [], 0
+    for _ in range(steps):
+        p, o, sc, loss, fi = step(p, o, sc, tokens, targets)
+        losses.append(float(loss))
+        skipped += int(float(fi) > 0)
+    return losses, sc, skipped
+
+
+def main(quick: bool = False):
+    from beforeholiday_tpu.guard import dispatch as gd
+    from beforeholiday_tpu.ops import quantized as Q
+    from beforeholiday_tpu.testing import gpt
+
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            f"quantized_bench expects the CPU backend, got "
+            f"{jax.default_backend()}"
+        )
+
+    steps = 50  # the ISSUE's >= 50-step parity window, quick or not
+    cfg = gpt.GPTConfig(
+        vocab_size=512, seq_len=64, d_model=64, n_heads=4,
+        n_layers=2, dtype=jnp.bfloat16,
+    )
+    batch = 4 if quick else 8
+
+    # ---------------- rung 1: per-matmul analytic error bound
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(32, 48).astype(np.float32))
+    w = jnp.asarray(rng.randn(48, 24).astype(np.float32))
+    y_q = Q.quantized_matmul(x, w)
+    y_ref = x @ w
+    mm_err = float(jnp.max(jnp.abs(y_q - y_ref)))
+    mm_bound = float(Q.quantized_matmul_error_bound(x, w))
+    if not mm_err <= mm_bound:
+        raise AssertionError(
+            f"quantized_matmul error {mm_err:.4g} exceeds its analytic "
+            f"bound {mm_bound:.4g}"
+        )
+
+    # ---------------- rung 2: >= 50-step O6 vs O5 loss parity
+    gd.reset_dispatch_counters()
+    l5, _, skip5 = _train_losses("O5", cfg, batch, steps)
+    l6, sc6, skip6 = _train_losses("O6", cfg, batch, steps)
+    if skip5 or skip6:
+        raise AssertionError(
+            f"unexpected skipped steps on the tiny rung (O5={skip5}, "
+            f"O6={skip6}) — overflow semantics should be quiescent here"
+        )
+
+    # every quantized GEMM on the loss path: 4 fused_dense per block
+    n_matmuls = 4 * cfg.n_layers
+    ceiling = max(abs(v) for v in l5)
+    devs, margins = [], []
+    for t, (a, b) in enumerate(zip(l5, l6)):
+        dev = abs(a - b)
+        bound = Q.loss_parity_bound(
+            t, n_matmuls=n_matmuls, loss_ceiling=ceiling
+        )
+        devs.append(dev)
+        margins.append(dev / bound)
+        if not dev <= bound:
+            raise AssertionError(
+                f"step {t}: O6 loss deviates {dev:.4g} from O5, outside the "
+                f"analytic parity bound {bound:.4g}"
+            )
+
+    # ---------------- rung 3: dispatch honesty + delayed-scaling state
+    q_counts = {"pallas": 0, "jnp": 0}
+    for key, c in gd.dispatch_counters().items():
+        if key[0] == "quantized_matmul":
+            q_counts["pallas"] += c["pallas"]
+            q_counts["jnp"] += c["jnp"]
+    if q_counts["pallas"] == 0:
+        raise AssertionError("no quantized_matmul dispatch reached fp8")
+    if q_counts["jnp"] != 0:
+        raise AssertionError(
+            f"{q_counts['jnp']} quantized_matmul dispatches degraded to the "
+            "jnp oracle — the fp8 fast path failed its probe"
+        )
+    hist = np.asarray(sc6["amax_history"])
+    if hist.shape[0] != len(Q.HISTORY_ROLES):
+        raise AssertionError(f"amax history rows {hist.shape} malformed")
+    for i, role in enumerate(Q.HISTORY_ROLES):
+        if not (hist[i] > 0).any():
+            raise AssertionError(f"amax history row {role!r} never populated")
+
+    # ---------------- pass 2: deterministic re-derivation for the gate
+    l6b, _, _ = _train_losses("O6", cfg, batch, steps)
+    margins2 = [
+        abs(a - b) / Q.loss_parity_bound(
+            t, n_matmuls=n_matmuls, loss_ceiling=ceiling
+        )
+        for t, (a, b) in enumerate(zip(l5, l6b))
+    ]
+
+    out = {
+        "o6_parity_steps": steps,
+        "o6_loss_parity_within_bound": True,
+        "o6_loss_parity_margin": round(max(margins), 6),
+        "o6_vs_o5_final_loss_dev": round(devs[-1], 6),
+        "o6_final_loss": round(l6[-1], 6),
+        "o5_final_loss": round(l5[-1], 6),
+        "o6_skipped_steps": skip6,
+        "quantized_matmul_err": round(mm_err, 6),
+        "quantized_matmul_bound": round(mm_bound, 6),
+        "quantized_dispatch": q_counts,
+        "o6_amax_history_rows": {
+            role: round(float(hist[i].max()), 6)
+            for i, role in enumerate(Q.HISTORY_ROLES)
+        },
+        "pass2": {
+            "o6_loss_parity_margin": round(max(margins2), 6),
+            "o6_vs_o5_final_loss_dev": round(abs(l5[-1] - l6b[-1]), 6),
+        },
+        "config": (
+            f"d={cfg.d_model} layers={cfg.n_layers} seq={cfg.seq_len} "
+            f"vocab={cfg.vocab_size} batch={batch} steps={steps}"
+        ),
+    }
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main(quick="--quick" in sys.argv[1:])

@@ -1,5 +1,10 @@
 """Tensor + sequence parallelism (ref: apex/transformer/tensor_parallel/)."""
 
+from beforeholiday_tpu.transformer.tensor_parallel.collective import (  # noqa: F401
+    all_gather_matmul,
+    collective_matmul_enabled,
+    set_collective_matmul,
+)
 from beforeholiday_tpu.transformer.tensor_parallel.cross_entropy import (  # noqa: F401
     vocab_parallel_cross_entropy,
 )

@@ -1,0 +1,125 @@
+"""Collective matmul — overlap the SP all-gather with the matmul it feeds.
+
+Wang et al. 2023 ("Overlap Communication with Dependent Computation via
+Decomposition"): the sequence-parallel ColumnParallel forward is
+``all_gather(x) @ w`` — a collective the matmul depends on, so XLA schedules
+them back-to-back and the interconnect time is fully exposed. Decomposing the
+gather into a ``ppermute`` ring makes the dependency chunk-local: at ring step
+k every rank matmuls the sequence chunk it already holds while the (k+1)-th
+chunk is in flight, so all but one hop hides under compute. Row-chunked
+``dot_general`` is bitwise-equal to the monolithic GEMM (rows are independent
+fp32/bf16 accumulations), and chunk k lands at the same gathered offset the
+tiled all-gather would place it — the decomposition changes the schedule,
+never the numbers.
+
+The backward replays the monolithic path's exact autodiff ops: ``dx`` is the
+cotangent matmul reduce-scattered over the same ``mappings`` helper the
+monolithic ``gather_from_sequence_parallel_region`` backward uses (so the
+chunking knob and compression semantics are inherited), ``dw`` is the local
+gathered-activation/cotangent contraction. The gathered activation is saved
+as the residual, exactly what autodiff through gather-then-matmul saves.
+
+Every hop books into the comms ledger under ``tp.collective_matmul:*`` sites.
+Default OFF: :func:`set_collective_matmul` (or the per-call knob on
+``column_parallel_linear``) turns it on.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from beforeholiday_tpu.monitor import comms
+from beforeholiday_tpu.parallel import bucketing
+from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
+from beforeholiday_tpu.transformer.tensor_parallel import mappings as mp
+
+__all__ = [
+    "all_gather_matmul",
+    "collective_matmul_enabled",
+    "set_collective_matmul",
+]
+
+_ENABLED = False
+
+
+def set_collective_matmul(enabled: bool) -> bool:
+    """Flip the module-wide default for the ``collective_matmul`` knob on the
+    SP ColumnParallel layers; returns the previous value."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(enabled)
+    return prev
+
+
+def collective_matmul_enabled() -> bool:
+    return _ENABLED
+
+
+def _ring_gather_matmul(x, w, axis_name):
+    """One ring pass: returns (y, xg) where ``y == all_gather(x, tiled) @ w``
+    and ``xg == all_gather(x, tiled)`` (the backward residual, assembled for
+    free from the same hops).
+
+    Chunk placement: after t hops of the (i -> i+1) ring, this rank holds the
+    chunk rank ``(rank - t) mod world`` contributed — written at that rank's
+    tiled-gather offset, so the assembled buffers match the monolithic layout
+    exactly. The hop-t ppermute and the hop-(t-1) chunk's matmul have no data
+    dependency — the dual-engine replay (and the TPU scheduler) runs them
+    concurrently, which is the whole point.
+    """
+    world = bucketing.static_axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    s = x.shape[0]
+    y0 = x @ w
+    y = jnp.zeros((world * s,) + y0.shape[1:], y0.dtype)
+    xg = jnp.zeros((world * s,) + x.shape[1:], x.dtype)
+    y = jax.lax.dynamic_update_slice_in_dim(y, y0, rank * s, 0)
+    xg = jax.lax.dynamic_update_slice_in_dim(xg, x, rank * s, 0)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    cur = x
+    for t in range(1, world):
+        cur = comms.ppermute(
+            cur, axis_name, perm, site=f"tp.collective_matmul:hop{t}"
+        )
+        src = (rank - t) % world
+        y = jax.lax.dynamic_update_slice_in_dim(y, cur @ w, src * s, 0)
+        xg = jax.lax.dynamic_update_slice_in_dim(xg, cur, src * s, 0)
+    return y, xg
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def all_gather_matmul(x, w, axis_name=TENSOR_AXIS):
+    """``all_gather(x, dim 0, tiled) @ w`` as an overlap-scheduled ppermute
+    ring — bitwise-equal to the monolithic gather-then-matmul, sequence-
+    parallel backward semantics (``dx`` reduce-scattered, Megatron's
+    ``tensor_parallel_output_grad=True``). x: (s_local, ..., K) this rank's
+    sequence chunk; w: (K, N) this rank's column shard; y: (s_local·world,
+    ..., N)."""
+    return _ring_gather_matmul(x, w, axis_name)[0]
+
+
+def _agm_fwd(x, w, axis_name):
+    y, xg = _ring_gather_matmul(x, w, axis_name)
+    return y, (xg, w)
+
+
+def _agm_bwd(axis_name, res, dy):
+    xg, w = res
+    # identical ops to autodiff through gather-then-matmul: cotangent GEMM,
+    # then the SP gather's reduce-scatter transpose (same mappings helper ->
+    # same chunking/ledger semantics as sp.gather_from_region.bwd)
+    dxg = jax.lax.dot_general(
+        dy, w, (((dy.ndim - 1,), (1,)), ((), ()))
+    ).astype(xg.dtype)
+    dx = mp._reduce_scatter(
+        dxg, 0, axis_name, site="tp.collective_matmul.bwd_dx"
+    )
+    lead = tuple(range(dy.ndim - 1))
+    dw = jax.lax.dot_general(xg, dy, ((lead, lead), ((), ()))).astype(w.dtype)
+    return dx, dw
+
+
+all_gather_matmul.defvjp(_agm_fwd, _agm_bwd)

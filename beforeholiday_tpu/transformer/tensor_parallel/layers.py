@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from beforeholiday_tpu.monitor.comms import ledger_scope
 from beforeholiday_tpu.parallel import bucketing
 from beforeholiday_tpu.parallel.parallel_state import TENSOR_AXIS
+from beforeholiday_tpu.transformer.tensor_parallel import collective as cm
 from beforeholiday_tpu.transformer.tensor_parallel import mappings as mp
 
 
@@ -32,6 +33,7 @@ def column_parallel_linear(
     *,
     gather_output: bool = False,
     sequence_parallel: bool = False,
+    collective_matmul: Optional[bool] = None,
     axis_name: str = TENSOR_AXIS,
 ) -> jax.Array:
     """Y = X @ A with A column-sharded (ref: layers.py:429 ``ColumnParallelLinear``).
@@ -40,15 +42,26 @@ def column_parallel_linear(
     are all-gathered before the GEMM and the backward reduce-scatters —
     the fusion at layers.py:293-306,355-363. Otherwise x is replicated and the
     f-conjugate (id fwd / psum bwd) applies.
+
+    ``collective_matmul`` (SP only; None = the module default from
+    ``collective.set_collective_matmul``, which starts OFF) runs the
+    gather+GEMM as the overlap-scheduled ppermute ring in
+    :mod:`.collective` — bitwise-equal output and grads, hops booked at
+    ``tp.collective_matmul:*``.
     """
     with ledger_scope("column_parallel_linear"):
-        if sequence_parallel:
-            x = mp.gather_from_sequence_parallel_region(
-                x, axis_name, True  # bwd reduce-scatters the dgrad
-            )
+        if collective_matmul is None:
+            collective_matmul = cm.collective_matmul_enabled()
+        if sequence_parallel and collective_matmul:
+            y = cm.all_gather_matmul(x, weight.astype(x.dtype), axis_name)
         else:
-            x = mp.copy_to_tensor_model_parallel_region(x, axis_name)
-        y = x @ weight.astype(x.dtype)
+            if sequence_parallel:
+                x = mp.gather_from_sequence_parallel_region(
+                    x, axis_name, True  # bwd reduce-scatters the dgrad
+                )
+            else:
+                x = mp.copy_to_tensor_model_parallel_region(x, axis_name)
+            y = x @ weight.astype(x.dtype)
         if bias is not None:
             y = y + bias.astype(y.dtype)
         if gather_output:

@@ -385,6 +385,46 @@ def make_flash_fwdbwd_rungs(S: int = 4096):
     }
 
 
+def make_flash_bwd_rung(S: int = 8192):
+    """Training-path flash attention at S=8192 under DEFAULT dispatch
+    (``impl=None``): at this shape the materialized-scores jnp oracle is over
+    the viability budget (the unfused backward does not even compile — the
+    r04 note), so the guarded dispatch books the kernel via ``count_forced``
+    and flash is the ONLY path. main() asserts the counters afterwards: zero
+    jnp dispatches for any S=8192 flash key, or the rung lied about what it
+    timed."""
+    from beforeholiday_tpu.ops import attention as A
+
+    B, H, D = 2, 16, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.bfloat16) for kk in ks)
+    sc = 1.0 / np.sqrt(D)
+
+    def flash_loss(q, k, v):
+        return A.flash_attention(
+            q, k, v, causal=True, scale=sc,  # impl=None: guarded default
+        ).astype(jnp.float32).sum()
+
+    return Chain(_fwdbwd_step_of(flash_loss), q, (k, v)).calibrate(), S
+
+
+def _flash_jnp_dispatches(S: int) -> int:
+    """Total jnp-oracle dispatches booked for flash_attention keys whose
+    operand signatures carry sequence length S."""
+    from beforeholiday_tpu.guard.dispatch import dispatch_counters
+
+    total = 0
+    for key, c in dispatch_counters().items():
+        if key[0] != "flash_attention":
+            continue
+        if any(
+            isinstance(sig, (tuple, list)) and S in tuple(sig[0])
+            for sig in key[2]
+        ):
+            total += c["jnp"]
+    return total
+
+
 def make_flash_dropout_rungs(S: int = 4096):
     """Training-path attention WITH attention-probability dropout — the exact
     configuration the reference's fused kernels exist for (dropout.cuh):
@@ -681,12 +721,16 @@ def make_bert_rung():
     return _first_candidate(candidates, run_one, "bert")
 
 
-def make_gpt_rung():
+def make_gpt_rung(opt_level: str = "O5"):
     """Flagship GPT training step (BASELINE config 5 shape): amp O5 with
     arena-NATIVE PackedParams (fp32 masters + model copy in one kernel pass,
     grads born flat) + flash attention + FusedAdam, single chip. Batch
-    pushed toward the HBM limit (VERDICT r4 next #7).
-    Returns ((chain, tokens, flops_per_step), tag)."""
+    pushed toward the HBM limit (VERDICT r4 next #7). ``opt_level="O6"``
+    swaps the block GEMMs onto the quantized (fp8-style) tier — same storage
+    semantics, only the matmul arithmetic changes.
+    Returns ((chain, tokens, flops_per_step, fp8_flops_per_step), tag);
+    ``fp8_flops_per_step`` is the share of the 6·N·tokens model flops whose
+    GEMMs run quantized (the block dense weights) — 0.0 for O5."""
     from beforeholiday_tpu import amp
     from beforeholiday_tpu.optimizers import FusedAdam
     from beforeholiday_tpu.testing import gpt
@@ -721,7 +765,7 @@ def make_gpt_rung():
         tokens, targets = gpt.synthetic_batch(jax.random.PRNGKey(1), cfg, batch)
         m = amp.initialize(
             lambda p, t: gpt.forward(p, t, cfg), params,
-            FusedAdam(lr=1e-4), "O5", arena_native=True,
+            FusedAdam(lr=1e-4), opt_level, arena_native=True,
         )
 
         def loss_fn(p, tok, tgt):
@@ -738,12 +782,23 @@ def make_gpt_rung():
             return (p, o, sc)
 
         n_params = sum(x.size for x in jax.tree.leaves(params))
+        tokens_per = batch * cfg.seq_len
+        fp8_flops = 0.0
+        if opt_level == "O6":
+            # the quantized tier routes exactly the block dense GEMMs
+            # (wqkv/wo/wi/wo2 via fused_dense); embedding/vocab-head stay bf16
+            n_dense = sum(
+                params["blocks"][k].size
+                for k in ("wqkv", "wo", "wi", "wo2")
+            )
+            fp8_flops = 6.0 * n_dense * tokens_per
         chain = Chain(
             step, (m.params, opt_state, sstate), (tokens, targets)
         ).calibrate(target_s=1.5)
-        return chain, batch * cfg.seq_len, 6.0 * n_params * batch * cfg.seq_len
+        return (chain, tokens_per,
+                6.0 * n_params * tokens_per - fp8_flops, fp8_flops)
 
-    return _first_candidate(candidates, run_one, "gpt")
+    return _first_candidate(candidates, run_one, f"gpt_{opt_level.lower()}")
 
 
 # ---------------------------------------------------------------------------------
@@ -970,6 +1025,60 @@ def bench_zero3():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_quantized():
+    """O6 quantized-tier rungs on a CPU subprocess. The child pins the
+    per-matmul quantized_matmul error inside its analytic bound, steps O5 and
+    O6 GPT runs >= 50 steps from identical init and asserts EVERY step's loss
+    deviation inside ``loss_parity_bound``, and requires the dispatch
+    counters to show the native-fp8 fast path with zero oracle downgrades —
+    all before printing. Deterministic end to end, so the gated keys
+    re-derive exactly. Same env scrub as ``bench_pp_overhead``."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.quantized_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"quantized_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def bench_collective_matmul():
+    """Collective-matmul rungs on the virtual 8-CPU mesh subprocess. The
+    child pins the ppermute-ring SP ColumnParallel forward and full backward
+    BITWISE against the monolithic gather-then-matmul (fp32 and bf16), checks
+    every ring hop books into the comms ledger at ``tp.collective_matmul:*``,
+    and asserts the ring's replayed overlap_fraction strictly above both the
+    monolithic and chunked-gather forms before printing. Same env scrub as
+    ``bench_pp_overhead``."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, "-m",
+         "beforeholiday_tpu.testing.collective_matmul_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"collective_matmul_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_infer():
     """Serving rungs (CPU subprocess): continuous vs static batching tokens/s
     at the same page budget, decode latency percentiles under a seeded
@@ -1043,13 +1152,20 @@ def main():
     # telemetry at the end re-derives each rung's MFU against this spec
     from beforeholiday_tpu import monitor as _monitor
 
+    # fp8 peak: the MXU's quantized-matmul rate is 2x the bf16 dense peak on
+    # every TPU generation with native fp8 — the O6 rung's MFU books its
+    # quantized GEMM share against this denominator (roofline.ChipSpec's own
+    # default, made explicit here so the JSON records the assumption)
     _monitor.register_chip_spec(
-        name="bench_chip", peak_tflops=peak_tflops, hbm_gbs=hbm_gbs)
+        name="bench_chip", peak_tflops=peak_tflops, hbm_gbs=hbm_gbs,
+        fp8_peak_tflops=2.0 * peak_tflops)
 
-    def mfu(model_flops, dt):
+    def mfu(model_flops, dt, fp8_flops=0.0):
         if not (peak_tflops and dt):
             return None
-        return round(model_flops / dt / 1e12 / peak_tflops, 4)
+        return round(
+            (model_flops / peak_tflops + fp8_flops / (2.0 * peak_tflops))
+            / dt / 1e12, 4)
 
     # Rung order is memory-aware: the big-model rungs run FIRST on a clean
     # chip (the d1024 GPT flagship at b16 peaks ~7 GB transient — fp32
@@ -1060,11 +1176,13 @@ def main():
     # tidiness.
 
     # --- GPT flagship (arena-native O5) ---
+    o5_step_s = o5_tag = None
     gpt_res = _stage(detail, make_gpt_rung)
     if gpt_res and gpt_res[0]:
-        (chain, tokens, flops), tag = gpt_res
+        (chain, tokens, flops, _), tag = gpt_res
         t = min(chain.samples(3))
         t2 = min(chain.samples(2))
+        o5_step_s, o5_tag = t, tag
         pass2["gpt_o5_step_ms"] = t2 * 1e3
         detail["gpt_o5_step_ms"] = round(t * 1e3, 2)
         detail["gpt_o5_tokens_per_s"] = round(tokens / t, 1)
@@ -1079,6 +1197,35 @@ def main():
         detail["gpt_d512_analysis_r5_recorded"] = R05_GPT_ANALYSIS
         chain = None
     gpt_res = None
+    _free()
+
+    # --- GPT flagship on the quantized tier (arena-native O6) ---
+    gpt6_res = _stage(detail, make_gpt_rung, "O6")
+    if gpt6_res and gpt6_res[0]:
+        (chain, tokens, flops, fp8_flops), tag = gpt6_res
+        t = min(chain.samples(3))
+        t2 = min(chain.samples(2))
+        pass2["gpt_o6_step_ms"] = t2 * 1e3
+        detail["gpt_o6_step_ms"] = round(t * 1e3, 2)
+        detail["gpt_o6_tokens_per_s"] = round(tokens / t, 1)
+        detail["gpt_o6_config"] = tag
+        detail["gpt_o6_fp8_flops_share"] = round(
+            fp8_flops / (flops + fp8_flops), 4)
+        m = mfu(flops, t, fp8_flops)
+        if m:
+            # fp8-aware MFU: bf16-class flops against the dense peak, the
+            # quantized GEMM share against the 2x fp8 peak
+            detail["gpt_o6_mfu"] = m
+        _monitor.record_wall_time("gpt_o6", t, flops=flops,
+                                  fp8_flops=fp8_flops)
+        pass2["perf_gpt_o6_mfu"] = mfu(flops, t2, fp8_flops)
+        if o5_step_s and tag == o5_tag:
+            # same winning config on both tiers -> the step ratio is a real
+            # O6-vs-O5 number, not a config artifact
+            detail["o6_vs_o5_step"] = round(t / o5_step_s, 3)
+            pass2["o6_vs_o5_step"] = t2 / o5_step_s
+        chain = None
+    gpt6_res = None
     _free()
 
     # --- BERT + LAMB (arena-native O5, step_flat, batch >= 64) ---
@@ -1178,6 +1325,25 @@ def main():
             _sub_ratio(t1, "unfused", "flash"), 3)
         pass2["flash_attn_fwdbwd_vs_unfused"] = _sub_ratio(t2, "unfused", "flash")
     fab = None
+    _free()
+
+    # --- flash bwd at S=8192: flash-only guarded dispatch ---
+    fb = _stage(detail, make_flash_bwd_rung)
+    if fb and fb[0]:
+        chain, S8 = fb
+        t = min(chain.samples(3))
+        t2 = min(chain.samples(2))
+        detail["flash_bwd_s8192_ms"] = round(t * 1e3, 2)
+        pass2["flash_bwd_s8192_ms"] = t2 * 1e3
+        jnp_hits = _stage(detail, _flash_jnp_dispatches, S8)
+        detail["flash_bwd_s8192_jnp_dispatches"] = jnp_hits
+        if jnp_hits:
+            detail["flash_bwd_s8192_error"] = (
+                f"{jnp_hits} dispatches took the jnp oracle at S=8192 — the "
+                "flash-only path broke; the timing above is not flash"
+            )
+        chain = None
+    fb = None
     _free()
 
     fdr = _stage(detail, make_flash_dropout_rungs)
@@ -1320,6 +1486,46 @@ def main():
             "before anything prints"
         )
         pass2.update(z3.get("pass2") or {})
+
+    # --- O6 quantized-tier parity + dispatch honesty (CPU subprocess) ---
+    qz = _stage(detail, bench_quantized)
+    if qz:
+        for k in ("o6_loss_parity_margin", "o6_vs_o5_final_loss_dev",
+                  "o6_parity_steps", "quantized_matmul_err",
+                  "quantized_matmul_bound"):
+            detail[k] = qz.get(k)
+        detail["quantized_bench"] = {
+            k: v for k, v in qz.items() if k != "pass2"
+        }
+        detail["quantized_note"] = (
+            "CPU-subprocess parity rung: O6 vs O5 losses over >= 50 steps "
+            "from identical init, every step asserted inside the analytic "
+            "loss_parity_bound; quantized_matmul dispatches must all take "
+            "the native-fp8 path (zero oracle downgrades) — deterministic, "
+            "so the gated keys re-derive exactly"
+        )
+        pass2.update(qz.get("pass2") or {})
+
+    # --- collective matmul: ring-overlapped SP gather+GEMM (CPU subprocess) ---
+    cmm = _stage(detail, bench_collective_matmul)
+    if cmm:
+        for k in ("collective_matmul_overlap_fraction",
+                  "tp_monolithic_overlap_fraction",
+                  "tp_chunked_overlap_fraction",
+                  "tp_collective_matmul_vs_chunked",
+                  "tp_collective_matmul_vs_mono_makespan"):
+            detail[k] = cmm.get(k)
+        detail["collective_matmul_bench"] = {
+            k: v for k, v in cmm.items() if k != "pass2"
+        }
+        detail["collective_matmul_note"] = (
+            "8-CPU-mesh jaxpr-replay proxy: numerics pinned bitwise vs the "
+            "monolithic gather-then-matmul (fwd + dx/dw/db, fp32 and bf16) "
+            "in the child; the gated claim is the strict overlap-fraction "
+            "inequality (ring hops hide under chunk GEMMs), makespans are "
+            "program-position facts, not TPU wall clock"
+        )
+        pass2.update(cmm.get("pass2") or {})
 
     # --- serving rungs: continuous vs static batching (CPU proxy, subprocess) ---
     inf = _stage(detail, bench_infer)

@@ -200,3 +200,55 @@ class TestDropoutDispatch:
         rate0 = A.flash_attention(q, k, v, causal=True, dropout_rate=0.0,
                                   dropout_key=jax.random.PRNGKey(1))
         np.testing.assert_array_equal(np.asarray(plain), np.asarray(rate0))
+
+
+class TestFlashOnlyDispatch:
+    """Above the oracle-score budget the jnp fallback is not a viable
+    degradation target (it materializes O(S^2) fp32 scores through autodiff),
+    so dispatch must become flash-ONLY: no probe, no downgrade, the dispatch
+    booked via ``count_forced`` — the S=8192 backward bench rung's contract,
+    pinned here at unit size by shrinking the budget instead of the shape."""
+
+    def _booked(self):
+        from beforeholiday_tpu.guard import dispatch as gd
+
+        out = {"pallas": 0, "jnp": 0, "probes": 0}
+        for key, c in gd.dispatch_counters().items():
+            if key[0] == "flash_attention":
+                for f in out:
+                    out[f] += c[f]
+        return out
+
+    def test_over_budget_books_forced_flash_no_probe(self, monkeypatch):
+        from beforeholiday_tpu.guard import dispatch as gd
+
+        # CPU resolves the default to jnp; force the TPU-side "pallas"
+        # resolution (interpret-mode kernel) so the budget branch is reachable
+        monkeypatch.setattr(A, "_resolve_impl", lambda impl: "pallas")
+        q, k, v = _qkv(jax.random.PRNGKey(11), B=1, H=1, S=128, D=32)
+        gd.reset_dispatch_counters()
+        prev = A.set_oracle_score_budget(1)  # 4*B*H*S*Sk >> 1: flash-only
+        try:
+            # forward AND backward ride the forced dispatch
+            g = jax.grad(lambda a: jnp.sum(A.flash_attention(a, k, v)))(q)
+        finally:
+            assert A.set_oracle_score_budget(prev) == 1
+        assert np.isfinite(np.asarray(g)).all()
+        booked = self._booked()
+        assert booked["pallas"] >= 1  # the flash-only dispatch is visible
+        assert booked["probes"] == 0  # probe skipped: nothing to degrade to
+        assert booked["jnp"] == 0  # the oracle is never taken
+
+    def test_under_budget_keeps_guarded_probe(self, monkeypatch):
+        from beforeholiday_tpu.guard import dispatch as gd
+
+        monkeypatch.setattr(A, "_resolve_impl", lambda impl: "pallas")
+        q, k, v = _qkv(jax.random.PRNGKey(12), B=1, H=1, S=128, D=32)
+        gd.clear_probe_cache("flash_attention")
+        gd.reset_dispatch_counters()
+        assert 4 * 1 * 1 * 128 * 128 <= A.oracle_score_budget()
+        out = A.flash_attention(q, k, v)
+        assert np.isfinite(np.asarray(out)).all()
+        booked = self._booked()
+        assert booked["pallas"] >= 1
+        assert booked["probes"] >= 1  # the guard probed as usual

@@ -1,0 +1,140 @@
+"""Fusion/donation audit over the bench GPT step closures (O5 + O6).
+
+The bench chains time a jitted ``step(state, tokens, targets) -> state``
+closure (bench.py ``make_gpt_rung``); these tests walk the SAME closure shape
+at test size and pin the properties the timings silently assume:
+
+* **Zero per-step host transfers** — after warmup, steps run to completion
+  under ``jax.transfer_guard("disallow")`` with device-committed inputs. Any
+  hidden ``.item()``/implicit readback in the amp/optimizer/scaler path would
+  raise here (the runtime counterpart of the AST scan in test_no_host_sync).
+* **No undonated-arena warnings** — the arena-native rungs carry a
+  ``PackedParams`` arena in the step state; wiring it through
+  ``remat.donate_step``'s donated slot must NOT trip the undonated-arena
+  sentinel (and passing it undonated MUST — the sentinel works).
+* **Dispatch honesty on O6** — tracing the O6 step books every
+  ``quantized_matmul`` on the fp8 fast path, zero jnp-oracle downgrades.
+
+One GPT step is built and compiled ONCE per opt level (module cache): the
+audits here are properties of the traced program, so every test reads the
+same compile.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from beforeholiday_tpu import amp, remat
+from beforeholiday_tpu.guard import dispatch as gd
+from beforeholiday_tpu.optimizers import FusedAdam
+from beforeholiday_tpu.testing import gpt
+from beforeholiday_tpu.utils import logging as bh_logging
+
+pytestmark = pytest.mark.quantized
+
+_DONATION_PREFIX = "remat.donation"
+
+
+@functools.lru_cache(maxsize=None)
+def _built(opt_level: str):
+    """The bench GPT rung's step closure at test size (same construction:
+    amp.initialize arena-native + scaled_value_and_grad + FusedAdam),
+    compiled once; returns (jstep, state_factory, inv, quantized_counts)."""
+    cfg = gpt.GPTConfig(
+        vocab_size=128, seq_len=16, d_model=32, n_heads=2, n_layers=1,
+        dtype=jnp.bfloat16,
+    )
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    tokens, targets = gpt.synthetic_batch(jax.random.PRNGKey(1), cfg, 2)
+    m = amp.initialize(
+        lambda p, t: gpt.forward(p, t, cfg), params,
+        FusedAdam(lr=1e-4), opt_level, arena_native=True,
+    )
+
+    def loss_fn(p, tok, tgt):
+        return gpt.loss_fn(p, tok, tgt, cfg, forward_fn=m.apply)
+
+    svag = amp.scaled_value_and_grad(loss_fn, m.scaler)
+
+    def step(s, tokens, targets):
+        p, o, sc = s
+        loss, g, fi, sc = svag(p, sc, tokens, targets)
+        p, o = m.optimizer.step(p, g, o, found_inf=fi)
+        return (p, o, sc)
+
+    def state_factory():
+        # fresh buffers every call: donation tests consume their state
+        return jax.tree_util.tree_map(
+            jnp.array, (m.params, m.optimizer.init(m.params), m.scaler.init())
+        )
+
+    gd.reset_dispatch_counters()
+    jstep = jax.jit(step)
+    jax.block_until_ready(jstep(state_factory(), tokens, targets))  # warmup
+    q_counts = {"pallas": 0, "jnp": 0}
+    for key, c in gd.dispatch_counters().items():
+        if key[0] == "quantized_matmul":
+            q_counts["pallas"] += c["pallas"]
+            q_counts["jnp"] += c["jnp"]
+    return jstep, state_factory, (tokens, targets), q_counts
+
+
+def _donation_warn_keys():
+    with bh_logging._WARNED_LOCK:
+        return [
+            k for k in bh_logging._WARNED
+            if isinstance(k, tuple) and k and k[0] == _DONATION_PREFIX
+        ]
+
+
+class TestNoPerStepHostTransfers:
+    @pytest.mark.parametrize("opt_level", ["O5", "O6"])
+    def test_steps_run_under_transfer_guard(self, opt_level):
+        jstep, state_factory, inv, _ = _built(opt_level)
+        state = jax.block_until_ready(jstep(state_factory(), *inv))
+        inv = jax.device_put(inv)
+        with jax.transfer_guard("disallow"):
+            for _ in range(3):
+                state = jstep(state, *inv)
+        # readback AFTER the guard: the step itself must be transfer-free
+        assert jax.block_until_ready(state) is state
+
+
+class TestDonationAudit:
+    @pytest.mark.parametrize("opt_level", ["O5", "O6"])
+    def test_donated_arena_state_warns_nothing(self, opt_level):
+        jstep, state_factory, inv, _ = _built(opt_level)
+        before = set(_donation_warn_keys())
+        dstep = remat.donate_step(jstep, donate_argnums=(0,))
+        state = dstep(state_factory(), *inv)
+        state = dstep(state, *inv)  # rebind each step — the donation contract
+        jax.block_until_ready(state)
+        new = set(_donation_warn_keys()) - before
+        assert not new, f"undonated-arena warnings on {opt_level}: {new}"
+
+    def test_sentinel_catches_undonated_arena(self):
+        """Control: the audit above is only meaningful if the sentinel fires
+        when an arena really does ride an undonated slot. The sentinel is a
+        host-side arg walk, so a trivial jitted body suffices."""
+        _, state_factory, _, _ = _built("O5")
+        before = set(_donation_warn_keys())
+        dstep = remat.donate_step(lambda n, s: n, donate_argnums=(0,))
+        try:
+            jax.block_until_ready(dstep(jnp.int32(0), state_factory()))
+            new = set(_donation_warn_keys()) - before
+            assert new, "undonated PackedParams arena went unflagged"
+        finally:
+            for k in set(_donation_warn_keys()) - before:
+                bh_logging.reset_warn_once(k)
+
+
+class TestO6DispatchHonesty:
+    def test_traced_step_books_only_fp8(self):
+        _, _, _, counts = _built("O6")
+        assert counts["pallas"] > 0, "O6 step traced no quantized_matmul"
+        assert counts["jnp"] == 0, (
+            f"{counts['jnp']} quantized_matmul dispatches degraded to the "
+            "jnp oracle inside the bench step closure"
+        )

@@ -284,3 +284,27 @@ def test_zero3_engine_is_scanned():
         path.startswith("optimizers/") for path in _SANCTIONED_BY_FILE
     )
     assert not any(path.startswith("optimizers/") for path, _ in _WAIVED)
+
+
+def test_quantized_tier_is_scanned():
+    """The O6 tier is hot-path-only by construction: ops/quantized.py keeps
+    every amax/scale decision device-side (its docstring's tracer-hygiene
+    contract), and the collective-matmul ring in tensor_parallel/collective.py
+    runs inside shard_map where any readback would deadlock a rank. Pin that
+    both files sit inside the scanner's reach with ZERO file-scoped sanctions
+    and ZERO waivers — a future ``.item()`` on an amax observation or a hop
+    count must fail this suite, not ship."""
+    for rel in (
+        "ops/quantized.py",
+        "transformer/tensor_parallel/collective.py",
+    ):
+        assert (_PKG_ROOT / rel).is_file(), rel
+        assert pathlib.Path(rel).parts[0] not in _SKIP_DIRS
+    assert not any(
+        path.startswith(("ops/quantized", "transformer/tensor_parallel/"))
+        for path in _SANCTIONED_BY_FILE
+    )
+    assert not any(
+        path.startswith(("ops/quantized", "transformer/tensor_parallel/"))
+        for path, _ in _WAIVED
+    )
