@@ -48,6 +48,7 @@ __all__ = [
     "all_to_all",
     "comms_records",
     "comms_summary",
+    "infer_tier",
     "ledger_scope",
     "pmax",
     "pmin",
@@ -59,9 +60,29 @@ __all__ = [
 ]
 
 _LOCK = threading.Lock()
-# (kind, axis, dtype, site, scope) -> {"calls": n, "bytes": b}
-_RECORDS: Dict[Tuple[str, str, str, str, str], Dict[str, int]] = {}
+# (kind, axis, dtype, site, scope, tier) -> {"calls": n, "bytes": b}
+_RECORDS: Dict[Tuple[str, str, str, str, str, str], Dict[str, int]] = {}
 _TLS = threading.local()
+
+# Mesh axes that cross the slow inter-slice (DCN) tier. A collective whose
+# axis spec touches any of these is booked as "dcn" — its slowest hop sets its
+# cost — everything else is on-slice ICI. Matches parallel_state.SLICE_AXIS
+# (string literal here to keep monitor/ free of parallel/ imports).
+DCN_AXES = frozenset({"slice"})
+
+
+def _axis_names(axis_name: Any) -> Tuple[str, ...]:
+    """Axis spec → tuple of axis-name strings (handles single names and the
+    tuple specs jax collectives accept)."""
+    if isinstance(axis_name, (tuple, list)):
+        return tuple(str(a) for a in axis_name)
+    return (str(axis_name),)
+
+
+def infer_tier(axis_name: Any) -> str:
+    """Default tier for a collective: "dcn" if its axis spec crosses a
+    slice boundary, else "ici"."""
+    return "dcn" if any(a in DCN_AXES for a in _axis_names(axis_name)) else "ici"
 
 
 def _scope_stack() -> List[str]:
@@ -103,7 +124,8 @@ def _payload_bytes(tree: Any) -> Dict[str, int]:
 
 
 def record(
-    kind: str, axis_name: Any, tree: Any, *, site: str, logical: Any = None
+    kind: str, axis_name: Any, tree: Any, *, site: str, logical: Any = None,
+    tier: str = None,
 ) -> None:
     """Account one collective call (host-side, trace-time). Wrappers call
     this; call it directly only for a collective with no wrapper here.
@@ -114,8 +136,14 @@ def record(
     ``logical`` — pass ``jax.ShapeDtypeStruct``s to avoid building dead cast
     ops — and the row's ``logical_bytes`` then records what the payload WOULD
     have cost uncompressed. For ordinary collectives
-    ``logical_bytes == bytes``."""
+    ``logical_bytes == bytes``.
+
+    ``tier`` books the record against an interconnect tier ("ici" on-slice,
+    "dcn" inter-slice); when omitted it is inferred from the axis spec via
+    ``infer_tier`` — pre-tier call sites keep summarizing unchanged."""
     scope = ".".join(_scope_stack())
+    if tier is None:
+        tier = infer_tier(axis_name)
     payload = _payload_bytes(tree)
     wire_total = sum(payload.values())
     logical_total = (
@@ -125,7 +153,7 @@ def record(
     )
     with _LOCK:
         for dtype_name, nbytes in payload.items():
-            key = (kind, str(axis_name), dtype_name, site, scope)
+            key = (kind, str(axis_name), dtype_name, site, scope, tier)
             row = _RECORDS.setdefault(
                 key, {"calls": 0, "bytes": 0, "logical_bytes": 0}
             )
@@ -147,7 +175,8 @@ def record(
     if rec is not None:
         rec.instant(
             f"{kind}:{site}",
-            args={"axis": str(axis_name), "scope": scope, **payload},
+            args={"axis": str(axis_name), "scope": scope, "tier": tier,
+                  **payload},
         )
 
 
@@ -156,49 +185,51 @@ def record(
 # keyword ``site`` tag; the ledger sees the LOCAL input operand.
 
 
-def psum(x, axis_name, *, site: str, axis_index_groups=None, logical=None):
-    record("psum", axis_name, x, site=site, logical=logical)
+def psum(x, axis_name, *, site: str, axis_index_groups=None, logical=None,
+         tier=None):
+    record("psum", axis_name, x, site=site, logical=logical, tier=tier)
     return jax.lax.psum(x, axis_name, axis_index_groups=axis_index_groups)
 
 
-def pmax(x, axis_name, *, site: str, axis_index_groups=None):
-    record("pmax", axis_name, x, site=site)
+def pmax(x, axis_name, *, site: str, axis_index_groups=None, tier=None):
+    record("pmax", axis_name, x, site=site, tier=tier)
     return jax.lax.pmax(x, axis_name, axis_index_groups=axis_index_groups)
 
 
-def pmin(x, axis_name, *, site: str, axis_index_groups=None):
-    record("pmin", axis_name, x, site=site)
+def pmin(x, axis_name, *, site: str, axis_index_groups=None, tier=None):
+    record("pmin", axis_name, x, site=site, tier=tier)
     return jax.lax.pmin(x, axis_name, axis_index_groups=axis_index_groups)
 
 
 def all_gather(
     x, axis_name, *, site: str, axis: int = 0, tiled: bool = False,
-    logical=None,
+    logical=None, tier=None,
 ):
-    record("all_gather", axis_name, x, site=site, logical=logical)
+    record("all_gather", axis_name, x, site=site, logical=logical, tier=tier)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def psum_scatter(
     x, axis_name, *, site: str, scatter_dimension: int = 0,
-    tiled: bool = False, logical=None,
+    tiled: bool = False, logical=None, tier=None,
 ):
-    record("psum_scatter", axis_name, x, site=site, logical=logical)
+    record("psum_scatter", axis_name, x, site=site, logical=logical,
+           tier=tier)
     return jax.lax.psum_scatter(
         x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
     )
 
 
-def ppermute(x, axis_name, perm, *, site: str):
-    record("ppermute", axis_name, x, site=site)
+def ppermute(x, axis_name, perm, *, site: str, tier=None):
+    record("ppermute", axis_name, x, site=site, tier=tier)
     return jax.lax.ppermute(x, axis_name, perm)
 
 
 def all_to_all(
     x, axis_name, split_axis, concat_axis, *, site: str, tiled: bool = False,
-    logical=None,
+    logical=None, tier=None,
 ):
-    record("all_to_all", axis_name, x, site=site, logical=logical)
+    record("all_to_all", axis_name, x, site=site, logical=logical, tier=tier)
     return jax.lax.all_to_all(
         x, axis_name, split_axis, concat_axis, tiled=tiled
     )
@@ -209,11 +240,13 @@ def all_to_all(
 
 def comms_records() -> List[Dict[str, object]]:
     """Per-key snapshot, one JSON-ready row per distinct
-    (kind, axis, dtype, site, scope): ``{"kind", "axis", "dtype", "site",
-    "scope", "calls", "bytes", "logical_bytes"}``. ``calls``/``bytes`` count
-    trace-time issues (see the module contract for the scan-body multiplier
-    caveat); ``bytes`` is the WIRE payload, ``logical_bytes`` the
-    uncompressed equivalent (equal unless the site compresses)."""
+    (kind, axis, dtype, site, scope, tier): ``{"kind", "axis", "dtype",
+    "site", "scope", "tier", "calls", "bytes", "logical_bytes"}``.
+    ``calls``/``bytes`` count trace-time issues (see the module contract for
+    the scan-body multiplier caveat); ``bytes`` is the WIRE payload,
+    ``logical_bytes`` the uncompressed equivalent (equal unless the site
+    compresses); ``tier`` is the interconnect tier the payload crossed
+    ("ici" on-slice, "dcn" inter-slice)."""
     with _LOCK:
         items = [(k, dict(v)) for k, v in _RECORDS.items()]
     return sorted(
@@ -224,23 +257,31 @@ def comms_records() -> List[Dict[str, object]]:
                 "dtype": dtype,
                 "site": site,
                 "scope": scope,
+                "tier": tier,
                 "calls": c["calls"],
                 "bytes": c["bytes"],
                 "logical_bytes": c.get("logical_bytes", c["bytes"]),
             }
-            for (kind, axis, dtype, site, scope), c in items
+            for (kind, axis, dtype, site, scope, tier), c in items
         ),
-        key=lambda r: (r["site"], r["kind"], r["dtype"], r["scope"]),
+        key=lambda r: (r["site"], r["kind"], r["dtype"], r["scope"],
+                       r["tier"]),
     )
 
 
 def comms_summary() -> List[Dict[str, object]]:
     """Subsystem rollup, one row per site-tag prefix (the segment before the
     first ``.``): ``{"subsystem", "sites", "calls", "bytes", "logical_bytes",
-    "compression_ratio", "by_kind"}`` — the shape ``bench.py``/MULTICHIP
-    embed, mirroring ``dispatch_summary``. ``bytes`` totals are WIRE traffic
-    (actual ICI cost); ``compression_ratio = logical_bytes / bytes`` is 1.0
-    for uncompressed subsystems and ~2.0 for bf16-on-the-wire over fp32."""
+    "compression_ratio", "by_kind", "by_tier"}`` — the shape
+    ``bench.py``/MULTICHIP embed, mirroring ``dispatch_summary``. ``bytes``
+    totals are WIRE traffic (actual interconnect cost);
+    ``compression_ratio = logical_bytes / bytes`` is 1.0 for uncompressed
+    subsystems and ~2.0 for bf16-on-the-wire over fp32. ``by_tier`` splits
+    the same totals per interconnect tier ("ici"/"dcn"), each with its own
+    ``compression_ratio`` — the oracle surface for proving a hierarchical
+    reduce moved 1/slice_size of the flat payload over DCN. Records written
+    before the tier field existed roll up under "ici" (every pre-tier call
+    site was single-slice)."""
     rows = comms_records()
     by_sub: Dict[str, Dict[str, object]] = {}
     sites_seen: Dict[str, set] = {}
@@ -248,7 +289,7 @@ def comms_summary() -> List[Dict[str, object]]:
         sub = str(r["site"]).split(".", 1)[0]
         row = by_sub.setdefault(
             sub, {"subsystem": sub, "sites": 0, "calls": 0, "bytes": 0,
-                  "logical_bytes": 0, "by_kind": {}}
+                  "logical_bytes": 0, "by_kind": {}, "by_tier": {}}
         )
         sites_seen.setdefault(sub, set()).add(r["site"])
         row["calls"] += r["calls"]
@@ -259,12 +300,24 @@ def comms_summary() -> List[Dict[str, object]]:
         )
         kind_row["calls"] += r["calls"]
         kind_row["bytes"] += r["bytes"]
+        tier_row = row["by_tier"].setdefault(
+            r.get("tier", "ici"),
+            {"calls": 0, "bytes": 0, "logical_bytes": 0},
+        )
+        tier_row["calls"] += r["calls"]
+        tier_row["bytes"] += r["bytes"]
+        tier_row["logical_bytes"] += r["logical_bytes"]
     for sub, row in by_sub.items():
         row["sites"] = len(sites_seen[sub])
         row["compression_ratio"] = (
             round(row["logical_bytes"] / row["bytes"], 4)
             if row["bytes"] else 1.0
         )
+        for tier_row in row["by_tier"].values():
+            tier_row["compression_ratio"] = (
+                round(tier_row["logical_bytes"] / tier_row["bytes"], 4)
+                if tier_row["bytes"] else 1.0
+            )
     return sorted(by_sub.values(), key=lambda r: r["subsystem"])
 
 

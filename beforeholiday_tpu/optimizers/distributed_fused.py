@@ -41,7 +41,10 @@ from beforeholiday_tpu.ops.arena import (
     TILE, PackedParams, flatten, make_spec, unflatten,
 )
 from beforeholiday_tpu.parallel import bucketing
-from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
+from beforeholiday_tpu.parallel.parallel_state import (
+    DATA_AXIS,
+    hierarchical_axes,
+)
 
 
 def _shard_len(total_padded: int, world: int) -> int:
@@ -66,19 +69,37 @@ class _DistributedFused:
     def __init__(
         self,
         *,
-        axis_name: str = DATA_AXIS,
+        axis_name: Any = DATA_AXIS,
         grad_average: bool = True,
         bucket_bytes: Optional[int] = None,
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
         overlap_backward: bool = False,
+        hierarchical: bool = False,
+        compress_intra: Optional[bool] = None,
+        compress_dcn: Optional[bool] = None,
     ):
+        if hierarchical and hierarchical_axes(axis_name) is None:
+            raise ValueError(
+                "hierarchical=True needs a (slice, intra) axis spec; got "
+                f"{axis_name!r}"
+            )
         self.axis_name = axis_name
         self.grad_average = grad_average
         self.bucket_bytes = bucket_bytes
         self.compress = compress
         self.wire_dtype = wire_dtype
         self.overlap_backward = overlap_backward
+        self.hierarchical = hierarchical
+        self.compress_intra = compress_intra
+        self.compress_dcn = compress_dcn
+
+    def _tier_compress(self) -> Tuple[bool, bool]:
+        ci = self.compress if self.compress_intra is None else (
+            self.compress_intra
+        )
+        cd = self.compress if self.compress_dcn is None else self.compress_dcn
+        return bool(ci), bool(cd)
 
     def _world(self):
         return bucketing.static_axis_size(self.axis_name)
@@ -133,33 +154,60 @@ class _DistributedFused:
             gleaves = jax.tree_util.tree_leaves(grads)
             gflat, _ = flatten(gleaves, dtype=jnp.float32)
         gflat = _pad_to(gflat, shard * self._world())
+        site = f"{self._site_prefix}.reduce_scatter_grads"
+        if self.hierarchical:
+            ci, cd = self._tier_compress()
+
+            def _scatter(concat):
+                return bucketing.hierarchical_psum_scatter(
+                    gflat, hierarchical_axes(self.axis_name), site=site,
+                    bucket_bytes=self.bucket_bytes, compress_intra=ci,
+                    compress_dcn=cd, wire_dtype=self.wire_dtype,
+                    concat=concat,
+                )
+        else:
+
+            def _scatter(concat):
+                return bucketing.bucketed_psum_scatter(
+                    gflat, self.axis_name, site=site,
+                    bucket_bytes=self.bucket_bytes, compress=self.compress,
+                    wire_dtype=self.wire_dtype, concat=concat,
+                )
         if not concat:
             # overlap path: keep the per-bucket pieces separate so each
             # bucket's consumer (its slice of the fused update) can start
             # the moment that bucket's reduce-scatter lands — the geometry
             # is bucket_slices(shard, 4 * world, bucket_bytes), fp32 arena
-            chunks = bucketing.bucketed_psum_scatter(
-                gflat, self.axis_name,
-                site=f"{self._site_prefix}.reduce_scatter_grads",
-                bucket_bytes=self.bucket_bytes, compress=self.compress,
-                wire_dtype=self.wire_dtype, concat=False,
-            )
+            chunks = _scatter(False)
             if self.grad_average:
                 chunks = [c / self._world() for c in chunks]
             return chunks
-        g_shard = bucketing.bucketed_psum_scatter(
-            gflat, self.axis_name,
-            site=f"{self._site_prefix}.reduce_scatter_grads",
-            bucket_bytes=self.bucket_bytes, compress=self.compress,
-            wire_dtype=self.wire_dtype,
-        )
+        g_shard = _scatter(True)
         if self.grad_average:
             g_shard = g_shard / self._world()
         return g_shard
 
     def _gather_params(self, master_shard, params, spec):
         leaves = jax.tree_util.tree_leaves(params)
-        if self.bucket_bytes is None and not self.compress:
+        if self.hierarchical:
+            # two-level re-materialization: each rank ships only its own
+            # shard over the slice (DCN) tier, then the intra gather fans the
+            # slice-local copies out — DCN carries 1/slice_size of the flat
+            # gather's bytes. Any tier compression puts wire_dtype on both
+            # legs (masters stay fp32, same contract as the flat path).
+            ci, cd = self._tier_compress()
+            wire = master_shard
+            logical_dtype = None
+            if ci or cd:
+                wire = master_shard.astype(self.wire_dtype)
+                logical_dtype = master_shard.dtype
+            full = bucketing.hierarchical_all_gather(
+                wire, hierarchical_axes(self.axis_name),
+                site=f"{self._site_prefix}.gather_params",
+                bucket_bytes=self.bucket_bytes, logical_dtype=logical_dtype,
+            )
+            pieces = unflatten(full[: spec.padded_total], spec)
+        elif self.bucket_bytes is None and not self.compress:
             pieces = self._gather_full(master_shard, spec)
         else:
             # bucketed re-materialization: independent per-bucket gathers XLA
@@ -240,18 +288,23 @@ class DistributedFusedAdam(_DistributedFused):
         adam_w_mode: bool = True,
         weight_decay: float = 0.0,
         bias_correction: bool = True,
-        axis_name: str = DATA_AXIS,
+        axis_name: Any = DATA_AXIS,
         grad_average: bool = True,
         bucket_bytes: Optional[int] = None,
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
         overlap_backward: bool = False,
+        hierarchical: bool = False,
+        compress_intra: Optional[bool] = None,
+        compress_dcn: Optional[bool] = None,
         impl: Optional[str] = None,
     ):
         super().__init__(
             axis_name=axis_name, grad_average=grad_average,
             bucket_bytes=bucket_bytes, compress=compress,
             wire_dtype=wire_dtype, overlap_backward=overlap_backward,
+            hierarchical=hierarchical, compress_intra=compress_intra,
+            compress_dcn=compress_dcn,
         )
         self.lr, self.betas, self.eps = lr, betas, eps
         self.adam_w_mode = adam_w_mode
@@ -360,12 +413,15 @@ class DistributedFusedLAMB(_DistributedFused):
         adam_w_mode: bool = True,
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
-        axis_name: str = DATA_AXIS,
+        axis_name: Any = DATA_AXIS,
         grad_average: bool = True,
         bucket_bytes: Optional[int] = None,
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
         overlap_backward: bool = False,
+        hierarchical: bool = False,
+        compress_intra: Optional[bool] = None,
+        compress_dcn: Optional[bool] = None,
         impl: Optional[str] = None,
     ):
         if overlap_backward:
@@ -382,7 +438,8 @@ class DistributedFusedLAMB(_DistributedFused):
         super().__init__(
             axis_name=axis_name, grad_average=grad_average,
             bucket_bytes=bucket_bytes, compress=compress,
-            wire_dtype=wire_dtype,
+            wire_dtype=wire_dtype, hierarchical=hierarchical,
+            compress_intra=compress_intra, compress_dcn=compress_dcn,
         )
         self.lr, self.betas, self.eps = lr, betas, eps
         self.weight_decay = weight_decay

@@ -74,7 +74,10 @@ from beforeholiday_tpu.optimizers.distributed_fused import (
     DistributedFusedAdam, _pad_to, _shard_len,
 )
 from beforeholiday_tpu.parallel import bucketing
-from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
+from beforeholiday_tpu.parallel.parallel_state import (
+    DATA_AXIS,
+    hierarchical_axes,
+)
 from beforeholiday_tpu.remat.policies import ZERO3_GATHERED_TAG
 
 __all__ = [
@@ -157,7 +160,7 @@ def _stripe_plan(
 
 @functools.lru_cache(maxsize=256)
 def _gather_fn(
-    axis_name: str,
+    axis_name: Any,
     layout: Zero3Layout,
     bucket_bytes: Optional[int],
     prefetch: int,
@@ -165,6 +168,9 @@ def _gather_fn(
     compress: bool,
     scatter_wire: str,
     site_prefix: str,
+    hierarchical: bool = False,
+    compress_intra: bool = False,
+    compress_dcn: bool = False,
 ):
     """Build the (cached) custom_vjp param gather for one static config.
 
@@ -172,11 +178,15 @@ def _gather_fn(
     rebuilt per-bucket-stripe (or the blocking concat form for prefetch=0).
     Backward: flatten the param cotangents to the fp32 arena and
     ``bucketed_psum_scatter`` into this rank's grad shard — ZeRO-2's exact
-    ``_reduce_scatter_grads`` op sequence, so grads match it bitwise."""
+    ``_reduce_scatter_grads`` op sequence, so grads match it bitwise.
+    ``hierarchical`` swaps both directions for the two-level engines
+    (slice-tier gather first / two-level scatter), so only 1/slice_size of
+    the arena crosses DCN each way."""
     spec = layout.spec
     gather_site = f"{site_prefix}.gather_params"
     grad_site = f"{site_prefix}.reduce_scatter_grads"
     wire_dt = jnp.dtype(gather_wire)
+    axes = hierarchical_axes(axis_name) if hierarchical else None
 
     def _impl(master_shard):
         world = bucketing.static_axis_size(axis_name)
@@ -196,10 +206,16 @@ def _gather_fn(
         if prefetch <= 0 or len(slices) == 1:
             # blocking form: the concat joins every bucket, so no consumer
             # starts before the whole arena has landed
-            full = bucketing.bucketed_all_gather(
-                wire, axis_name, site=gather_site,
-                bucket_bytes=bucket_bytes, logical_dtype=logical,
-            )
+            if hierarchical:
+                full = bucketing.hierarchical_all_gather(
+                    wire, axes, site=gather_site,
+                    bucket_bytes=bucket_bytes, logical_dtype=logical,
+                )
+            else:
+                full = bucketing.bucketed_all_gather(
+                    wire, axis_name, site=gather_site,
+                    bucket_bytes=bucket_bytes, logical_dtype=logical,
+                )
             pieces = unflatten(full[: spec.padded_total], spec)
             return tuple(
                 p.astype(dt) for p, dt in zip(pieces, layout.dtypes)
@@ -221,11 +237,17 @@ def _gather_fn(
             # kept flat (world*ln,): stripes are indexed directly, so the
             # only op between a bucket landing and its consumers is the
             # per-segment slice
-            gathered.append(comms.all_gather(
-                piece, axis_name, axis=0, tiled=True, site=gather_site,
-                logical=None if logical is None
-                else jax.ShapeDtypeStruct(piece.shape, logical),
-            ))
+            if hierarchical:
+                gathered.append(bucketing.hierarchical_all_gather(
+                    piece, axes, site=gather_site, bucket_bytes=None,
+                    logical_dtype=logical,
+                ))
+            else:
+                gathered.append(comms.all_gather(
+                    piece, axis_name, axis=0, tiled=True, site=gather_site,
+                    logical=None if logical is None
+                    else jax.ShapeDtypeStruct(piece.shape, logical),
+                ))
         plans = _stripe_plan(layout, shard, slices)
         leaves = []
         for segs, shape, dt in zip(plans, layout.shapes, layout.dtypes):
@@ -253,10 +275,17 @@ def _gather_fn(
         shard = _shard_len(spec.padded_total, world)
         gflat, _ = flatten([jnp.asarray(c) for c in cts], dtype=jnp.float32)
         gflat = _pad_to(gflat, shard * world)
-        g = bucketing.bucketed_psum_scatter(
-            gflat, axis_name, site=grad_site, bucket_bytes=bucket_bytes,
-            compress=compress, wire_dtype=jnp.dtype(scatter_wire),
-        )
+        if hierarchical:
+            g = bucketing.hierarchical_psum_scatter(
+                gflat, axes, site=grad_site, bucket_bytes=bucket_bytes,
+                compress_intra=compress_intra, compress_dcn=compress_dcn,
+                wire_dtype=jnp.dtype(scatter_wire),
+            )
+        else:
+            g = bucketing.bucketed_psum_scatter(
+                gflat, axis_name, site=grad_site, bucket_bytes=bucket_bytes,
+                compress=compress, wire_dtype=jnp.dtype(scatter_wire),
+            )
         return (g,)
 
     gather.defvjp(_fwd, _bwd)
@@ -294,12 +323,15 @@ class ZeRO3FusedAdam(DistributedFusedAdam):
         adam_w_mode: bool = True,
         weight_decay: float = 0.0,
         bias_correction: bool = True,
-        axis_name: str = DATA_AXIS,
+        axis_name: Any = DATA_AXIS,
         grad_average: bool = True,
         bucket_bytes: Optional[int] = bucketing.DEFAULT_BUCKET_BYTES,
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
         overlap_backward: bool = False,
+        hierarchical: bool = False,
+        compress_intra: Optional[bool] = None,
+        compress_dcn: Optional[bool] = None,
         impl: Optional[str] = None,
         prefetch: int = 1,
         param_residency: str = "regather",
@@ -310,7 +342,8 @@ class ZeRO3FusedAdam(DistributedFusedAdam):
             axis_name=axis_name, grad_average=grad_average,
             bucket_bytes=bucket_bytes, compress=compress,
             wire_dtype=wire_dtype, overlap_backward=overlap_backward,
-            impl=impl,
+            hierarchical=hierarchical, compress_intra=compress_intra,
+            compress_dcn=compress_dcn, impl=impl,
         )
         if prefetch < 0:
             raise ValueError(f"prefetch must be >= 0, got {prefetch}")
@@ -330,7 +363,7 @@ class ZeRO3FusedAdam(DistributedFusedAdam):
         master before vs after the gather is bitwise the same cast, so
         ZeRO-2 parity survives), otherwise fp32; ``compress`` forces
         ``wire_dtype``."""
-        if self.compress:
+        if self.compress or (self.hierarchical and any(self._tier_compress())):
             return np.dtype(self.wire_dtype).name
         if len(set(layout.dtypes)) == 1:
             return layout.dtypes[0]
@@ -341,10 +374,15 @@ class ZeRO3FusedAdam(DistributedFusedAdam):
 
         Differentiable: the custom VJP reduce-scatters the param cotangents
         into the fp32 grad shard (``zero3.reduce_scatter_grads``)."""
+        ci, cd = self._tier_compress()
         fn = _gather_fn(
-            self.axis_name, layout, self.bucket_bytes, self.prefetch,
+            self.axis_name
+            if hierarchical_axes(self.axis_name) is None
+            else hierarchical_axes(self.axis_name),
+            layout, self.bucket_bytes, self.prefetch,
             self._gather_wire(layout), self.compress,
             np.dtype(self.wire_dtype).name, self._site_prefix,
+            bool(self.hierarchical), ci, cd,
         )
         leaves = fn(master_shard)
         if self.param_residency == "regather":

@@ -28,6 +28,15 @@ Three guarantees every helper here keeps:
   ``monitor.comms`` wrappers: per-site ``calls`` is the bucket count,
   ``bytes`` the actual wire payload (bf16 when compressed), and
   ``logical_bytes``/``compression_ratio`` quantify what compression saved.
+  On a two-level ``(slice, intra)`` mesh every record also lands on an
+  interconnect tier ("ici"/"dcn"), so the per-tier rollup proves the
+  hierarchical engines move 1/slice_size of the flat payload over DCN.
+
+The two-level section below adds the multi-slice decomposition
+(``hierarchical_psum`` / ``hierarchical_psum_scatter`` /
+``hierarchical_all_gather``): intra-slice reduce-scatter, inter-slice psum
+on the 1/slice_size chunk, intra-slice all-gather — bitwise-equal to the
+flat path uncompressed, with independent per-tier wire compression.
 """
 
 from __future__ import annotations
@@ -42,7 +51,10 @@ import numpy as np
 
 from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.ops.arena import LANES
-from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
+from beforeholiday_tpu.parallel.parallel_state import (
+    DATA_AXIS,
+    hierarchical_axes,
+)
 
 __all__ = [
     "BucketedReduce",
@@ -55,6 +67,10 @@ __all__ = [
     "chunked_all_gather",
     "chunked_reduce_scatter",
     "compression_error_bound",
+    "hierarchical_all_gather",
+    "hierarchical_compression_error_bound",
+    "hierarchical_psum",
+    "hierarchical_psum_scatter",
     "n_buckets",
     "partition_leaves",
     "static_axis_size",
@@ -95,12 +111,46 @@ def compression_error_bound(sum_abs, wire_dtype: Any = jnp.bfloat16):
     return 2.0 * wire_eps(wire_dtype) * sum_abs
 
 
+def hierarchical_compression_error_bound(
+    sum_abs,
+    *,
+    compress_intra: bool = False,
+    compress_dcn: bool = False,
+    wire_dtype: Any = jnp.bfloat16,
+):
+    """Composed elementwise bound for a two-level reduce with per-tier
+    compression: ``|hierarchical_reduce - exact_reduce|``.
+
+    Each compressed tier contributes the flat all-reduce budget — one wire
+    rounding of its inputs plus one of its output, ``2 * wire_eps`` relative
+    to ``sum_abs = psum(|x|)`` over the FULL (slice x intra) world. The tiers
+    compose multiplicatively (the DCN stage re-rounds partials that already
+    carry intra-tier error), so the bound is ``((1 + 2e)^k - 1) * sum_abs``
+    with ``k`` the number of compressed tiers — first order ``2e`` per tier,
+    exactly ``compression_error_bound`` when one tier compresses and neither
+    tier compressing gives 0 (the uncompressed path is bitwise)."""
+    eps = wire_eps(wire_dtype)
+    factor = 1.0
+    if compress_intra:
+        factor *= 1.0 + 2.0 * eps
+    if compress_dcn:
+        factor *= 1.0 + 2.0 * eps
+    return (factor - 1.0) * sum_abs
+
+
 def static_axis_size(axis_name: Any) -> int:
     """The mesh axis size as a host Python int, inside a ``shard_map`` trace.
 
     ``lax.axis_size`` where it exists (jax >= 0.6); otherwise
     ``psum(1, axis)`` — on the old API a psum of a Python constant folds to a
-    static int at trace time, which is exactly what bucket geometry needs."""
+    static int at trace time, which is exactly what bucket geometry needs.
+    A tuple spec (the two-level ``(slice, intra)`` convention) returns the
+    product of the per-axis sizes — the flat world size."""
+    if isinstance(axis_name, (tuple, list)):
+        size = 1
+        for ax in axis_name:
+            size *= static_axis_size(ax)
+        return size
     size_fn = getattr(jax.lax, "axis_size", None)
     size = size_fn(axis_name) if size_fn is not None else jax.lax.psum(
         1, axis_name
@@ -190,6 +240,269 @@ def _compressed_allreduce(x, axis_name, *, site: str, wire_dtype):
     return out[:n] if pad else out
 
 
+# ------------------------------------------------- two-level (slice x intra)
+# The multi-slice decomposition: intra-slice reduce-scatter -> inter-slice
+# (DCN) psum on 1/slice_size of the data -> intra-slice all-gather, the same
+# hierarchy Apex's ``allreduce_communicators`` / NCCL trees exploit. Two
+# contracts make the flat and hierarchical paths comparable:
+#
+# * **Deterministic flat spelling.** On a two-level axis spec the FLAT
+#   uncompressed reduce is spelled as chained per-axis psums (intra tier
+#   first, then slice) rather than one joint-axis collective. A joint
+#   AllReduce's reduction order is XLA's choice (linear rank order on the CPU
+#   backend) and NO two-level decomposition can reproduce it — partials over
+#   the fast tier destroy the information an interleaved order needs. The
+#   chained spelling pins the order to intra-linear-then-slice, which is
+#   exactly the order the hierarchical path computes in, so hierarchical is
+#   bitwise-equal to flat while still moving the FULL payload over the slow
+#   tier (the contrast the ledger measures). Single-axis specs are untouched.
+# * **Per-tier ledger booking.** Collectives over the slice axis book as
+#   "dcn", everything else "ici" (``monitor.comms.infer_tier``), so
+#   ``comms_summary()['by_tier']`` proves the hierarchical path's DCN bytes
+#   are flat's / slice_size.
+
+
+def _sized_axes(axes: Tuple[str, str]) -> Tuple[Tuple[str, int], ...]:
+    """(axis, size) for the non-degenerate axes of a two-level spec, fast
+    tier first (reduction order); size-1 axes drop out so degenerate meshes
+    (slice_size=1 or n_slices=1) emit exactly the flat path's collectives."""
+    slice_axis, intra_axis = axes
+    out = []
+    for ax in (intra_axis, slice_axis):
+        size = static_axis_size(ax)
+        if size > 1:
+            out.append((ax, size))
+    return tuple(out)
+
+
+def _chained_psum(x, axes: Tuple[str, str], *, site: str):
+    """Deterministic flat all-reduce over a two-level spec: psum the fast
+    tier, then the slow one. ``x`` may be a leaf or a tuple of leaves (the
+    variadic tree-group form)."""
+    for ax, _ in _sized_axes(axes):
+        x = comms.psum(x, ax, site=site)
+    return x
+
+
+def hierarchical_psum(
+    flat,
+    axes: Tuple[str, str],
+    *,
+    site: str,
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    compress_intra: bool = False,
+    compress_dcn: bool = False,
+    wire_dtype: Any = jnp.bfloat16,
+):
+    """Two-level all-reduce of a flat arena: per bucket, intra-slice
+    reduce-scatter -> inter-slice psum on the 1/slice_size chunk -> intra
+    all-gather. Only the chunk crosses DCN — the slow tier carries
+    flat_bytes / slice_size.
+
+    Uncompressed this is bitwise-equal to the flat chained psum (see the
+    section comment). ``compress_intra`` sends the reduce-scatter and
+    all-gather legs in ``wire_dtype``; ``compress_dcn`` compresses the
+    inter-slice leg; accumulation stays fp32 on every tier and the
+    composed error is within ``hierarchical_compression_error_bound``.
+    Degenerate meshes (either axis size 1) collapse to the single-tier
+    bucketed path with that tier's compression knob — no extra collectives."""
+    if flat.ndim != 1:
+        raise ValueError(
+            f"hierarchical_psum wants a flat arena, got {flat.shape}"
+        )
+    slice_axis, intra_axis = axes
+    sized = _sized_axes(axes)
+    if len(sized) < 2:
+        # one (or zero) real tiers: the flat bucketed path IS the
+        # hierarchical one; keep the surviving tier's compression knob
+        if not sized:
+            return flat
+        ax, _ = sized[0]
+        return bucketed_psum(
+            flat, ax, site=site, bucket_bytes=bucket_bytes,
+            compress=(compress_dcn if ax == slice_axis else compress_intra),
+            wire_dtype=wire_dtype,
+        )
+    intra = static_axis_size(intra_axis)
+    slices = bucket_slices(flat.shape[0], flat.dtype.itemsize, bucket_bytes)
+    pieces = []
+    for off, ln in slices:
+        piece = _slice_flat(flat, off, ln)
+        chunk = -(-ln // intra)
+        pad = chunk * intra - ln
+        xp = jnp.pad(piece, (0, pad)) if pad else piece
+        if compress_intra:
+            wire = xp.reshape(intra, chunk).astype(wire_dtype)
+            recv = comms.all_to_all(
+                wire, intra_axis, 0, 0, site=site,
+                logical=_logical(wire.shape, piece.dtype),
+            )
+            red = jnp.sum(recv.astype(jnp.float32), axis=0)
+        else:
+            red = comms.psum_scatter(
+                xp, intra_axis, scatter_dimension=0, tiled=True, site=site
+            )
+        if compress_dcn:
+            red = _compressed_allreduce(
+                red, slice_axis, site=site, wire_dtype=wire_dtype
+            )
+        else:
+            red = comms.psum(red, slice_axis, site=site)
+        if compress_intra:
+            g = comms.all_gather(
+                red.astype(wire_dtype), intra_axis, axis=0, tiled=True,
+                site=site, logical=_logical(red.shape, jnp.float32),
+            )
+        else:
+            g = comms.all_gather(
+                red, intra_axis, axis=0, tiled=True, site=site
+            )
+        out = (
+            g.astype(flat.dtype)
+            if (compress_intra or compress_dcn) else g
+        )
+        pieces.append(out[:ln] if pad else out)
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
+def hierarchical_psum_scatter(
+    flat,
+    axes: Tuple[str, str],
+    *,
+    site: str,
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    compress_intra: bool = False,
+    compress_dcn: bool = False,
+    wire_dtype: Any = jnp.bfloat16,
+    concat: bool = True,
+):
+    """Two-level reduce-scatter of a (world*shard,) arena into this rank's
+    (shard,) piece, shard ownership identical to the flat path (rank
+    ``slice * slice_size + intra`` owns shard ``r`` — the slice-major mesh
+    order). Per shard-column bucket: reorder the rank-major view
+    intra-major, reduce-scatter over the intra tier (each intra rank is left
+    holding the per-slice partials of its slice_size-th of the column), then
+    reduce-scatter the 1/slice_size remainder over DCN. Bucketing and
+    ``concat=False`` semantics match ``bucketed_psum_scatter``."""
+    world = static_axis_size(axes)
+    total = flat.shape[0]
+    if flat.ndim != 1 or total % world:
+        raise ValueError(
+            f"hierarchical_psum_scatter wants a flat arena divisible by the "
+            f"world size, got shape {flat.shape} over world={world}"
+        )
+    slice_axis, intra_axis = axes
+    sized = _sized_axes(axes)
+    if len(sized) < 2:
+        if not sized:
+            return flat if concat else [flat]
+        ax, _ = sized[0]
+        return bucketed_psum_scatter(
+            flat, ax, site=site, bucket_bytes=bucket_bytes,
+            compress=(compress_dcn if ax == slice_axis else compress_intra),
+            wire_dtype=wire_dtype, concat=concat,
+        )
+    n_slices = static_axis_size(slice_axis)
+    intra = static_axis_size(intra_axis)
+    shard = total // world
+    mat = flat.reshape(world, shard)
+    slices = bucket_slices(shard, flat.dtype.itemsize * world, bucket_bytes)
+    pieces = []
+    for off, ln in slices:
+        col = jax.lax.slice_in_dim(mat, off, off + ln, axis=1)
+        # (world, ln) rank-major -> (intra, n_slices, ln): intra rank i's
+        # scatter chunk is the per-slice stack of destination rows
+        # (s*intra + i for every s), so the second-stage DCN scatter lands
+        # each rank exactly its flat-path shard
+        im = jnp.transpose(col.reshape(n_slices, intra, ln), (1, 0, 2))
+        if compress_intra:
+            wire = im.reshape(intra, n_slices * ln).astype(wire_dtype)
+            recv = comms.all_to_all(
+                wire, intra_axis, 0, 0, site=site,
+                logical=_logical(wire.shape, flat.dtype),
+            )
+            red = jnp.sum(recv.astype(jnp.float32), axis=0)
+        else:
+            red = comms.psum_scatter(
+                im.reshape(intra * n_slices * ln), intra_axis,
+                scatter_dimension=0, tiled=True, site=site,
+            )
+        if compress_dcn:
+            wire = red.reshape(n_slices, ln).astype(wire_dtype)
+            recv = comms.all_to_all(
+                wire, slice_axis, 0, 0, site=site,
+                logical=_logical(wire.shape, flat.dtype),
+            )
+            piece = jnp.sum(recv.astype(jnp.float32), axis=0)
+        else:
+            piece = comms.psum_scatter(
+                red, slice_axis, scatter_dimension=0, tiled=True, site=site
+            )
+        if compress_intra or compress_dcn:
+            piece = piece.astype(flat.dtype)
+        pieces.append(piece)
+    if not concat:
+        return pieces
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
+def hierarchical_all_gather(
+    shard,
+    axes: Tuple[str, str],
+    *,
+    site: str,
+    bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    logical_dtype: Any = None,
+):
+    """Two-level all-gather of per-rank (shard,) pieces into the rank-major
+    (world*shard,) arena: gather over the slice (DCN) tier first — each rank
+    ships only its own shard across the slow link — then over the intra tier,
+    and un-interleave back to slice-major rank order. Bitwise-identical to
+    the flat joint-axis gather (gathers move data, no arithmetic)."""
+    world = static_axis_size(axes)
+    if shard.ndim != 1:
+        raise ValueError(
+            f"hierarchical_all_gather wants a flat shard, got {shard.shape}"
+        )
+    slice_axis, intra_axis = axes
+    sized = _sized_axes(axes)
+    if len(sized) < 2:
+        if not sized:
+            return shard
+        return bucketed_all_gather(
+            shard, sized[0][0], site=site, bucket_bytes=bucket_bytes,
+            logical_dtype=logical_dtype,
+        )
+    n_slices = static_axis_size(slice_axis)
+    intra = static_axis_size(intra_axis)
+    n = shard.shape[0]
+    slices = bucket_slices(n, shard.dtype.itemsize, bucket_bytes)
+    parts = []
+    for off, ln in slices:
+        piece = _slice_flat(shard, off, ln)
+        logical = (
+            None if logical_dtype is None
+            else _logical(piece.shape, logical_dtype)
+        )
+        ga = comms.all_gather(
+            piece, slice_axis, axis=0, tiled=True, site=site, logical=logical
+        )
+        gb = comms.all_gather(
+            ga, intra_axis, axis=0, tiled=True, site=site,
+            logical=None if logical_dtype is None
+            else _logical(ga.shape, logical_dtype),
+        )
+        # (intra, n_slices, ln) -> slice-major (world, ln) rank order
+        parts.append(
+            jnp.transpose(gb.reshape(intra, n_slices, ln), (1, 0, 2)).reshape(
+                world, ln
+            )
+        )
+    if len(parts) == 1:
+        return parts[0].reshape(world * n)
+    return jnp.concatenate(parts, axis=1).reshape(world * n)
+
+
 def bucketed_psum(
     flat,
     axis_name: Any,
@@ -204,10 +517,18 @@ def bucketed_psum(
     Uncompressed buckets are plain ``psum`` slices — bitwise identical to the
     monolithic ``psum`` regardless of bucket size. ``compress=True`` sends
     each bucket over the wire in ``wire_dtype`` with fp32 accumulation (see
-    module docstring for the error bound) and returns in the input dtype."""
+    module docstring for the error bound) and returns in the input dtype.
+
+    On a two-level ``(slice, intra)`` spec the uncompressed reduce is spelled
+    as chained per-axis psums — full payload on BOTH tiers, deterministic
+    intra-then-slice order (see the two-level section comment) — making this
+    the flat baseline ``hierarchical_psum`` is bitwise-equal to."""
     if flat.ndim != 1:
         raise ValueError(f"bucketed_psum wants a flat arena, got {flat.shape}")
+    axes = hierarchical_axes(axis_name)
     if not compress and bucket_bytes is None:
+        if axes is not None:
+            return _chained_psum(flat, axes, site=site)
         return comms.psum(flat, axis_name, site=site)
     slices = bucket_slices(flat.shape[0], flat.dtype.itemsize, bucket_bytes)
     pieces = []
@@ -217,6 +538,8 @@ def bucketed_psum(
             piece = _compressed_allreduce(
                 piece, axis_name, site=site, wire_dtype=wire_dtype
             ).astype(flat.dtype)
+        elif axes is not None:
+            piece = _chained_psum(piece, axes, site=site)
         else:
             piece = comms.psum(piece, axis_name, site=site)
         pieces.append(piece)
@@ -247,7 +570,13 @@ def bucketed_psum_scatter(
     order, geometry ``bucket_slices(shard, itemsize * world, bucket_bytes)``)
     instead of concatenating — the optimizer-in-backward path consumes each
     bucket as it lands, and the concat at the end of *its* consumers would
-    otherwise serialize every bucket behind the slowest one."""
+    otherwise serialize every bucket behind the slowest one.
+
+    On a two-level ``(slice, intra)`` spec the uncompressed form is spelled
+    as the chained all-reduce plus a local shard slice — the deterministic
+    full-DCN-payload flat baseline ``hierarchical_psum_scatter`` is
+    bitwise-equal to (a joint-axis reduce-scatter's order is XLA's choice;
+    see the two-level section comment)."""
     world = static_axis_size(axis_name)
     total = flat.shape[0]
     if flat.ndim != 1 or total % world:
@@ -255,7 +584,8 @@ def bucketed_psum_scatter(
             f"bucketed_psum_scatter wants a flat arena divisible by the axis "
             f"size, got shape {flat.shape} over world={world}"
         )
-    if not compress and bucket_bytes is None:
+    axes = hierarchical_axes(axis_name)
+    if not compress and bucket_bytes is None and axes is None:
         whole = comms.psum_scatter(
             flat, axis_name, scatter_dimension=0, tiled=True, site=site
         )
@@ -276,6 +606,10 @@ def bucketed_psum_scatter(
             piece = jnp.sum(recv.astype(jnp.float32), axis=0).astype(
                 flat.dtype
             )
+        elif axes is not None:
+            full = _chained_psum(col.reshape(world * ln), axes, site=site)
+            rank = jax.lax.axis_index(tuple(axes))
+            piece = jax.lax.dynamic_slice_in_dim(full, rank * ln, ln)
         else:
             piece = comms.psum_scatter(
                 col.reshape(world * ln), axis_name, scatter_dimension=0,
@@ -452,29 +786,54 @@ def bucketed_tree_psum(
     bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
     compress: bool = False,
     wire_dtype: Any = jnp.bfloat16,
+    hierarchical: bool = False,
+    compress_intra: bool = False,
+    compress_dcn: bool = False,
 ) -> List[Any]:
     """All-reduce a leaf list group-by-group; returns reduced leaves in the
-    original order/dtypes. Non-float groups always go uncompressed."""
+    original order/dtypes. Non-float groups always go uncompressed. On a
+    two-level axis spec the uncompressed groups reduce via the chained
+    per-axis psum (the deterministic flat spelling); ``hierarchical=True``
+    concatenates each float group and routes it through
+    ``hierarchical_psum`` instead, with per-tier compression knobs."""
+    axes = hierarchical_axes(axis_name)
+    if hierarchical and axes is None:
+        raise ValueError(
+            "hierarchical=True needs a (slice, intra) axis spec; got "
+            f"{axis_name!r}"
+        )
     out: List[Any] = [None] * len(leaves)
     for group in partition_leaves(leaves, bucket_bytes):
         sub = [leaves[i] for i in group]
         dt = np.dtype(jnp.result_type(sub[0]))
         # jnp.issubdtype, not np: ml_dtypes (bfloat16) sit outside numpy's
         # type lattice — a bf16 grad group still wants fp32 accumulation
-        if compress and jnp.issubdtype(dt, jnp.floating):
+        is_float = jnp.issubdtype(dt, jnp.floating)
+        if (compress or hierarchical) and is_float:
             flat = (
                 sub[0].reshape(-1) if len(sub) == 1
                 else jnp.concatenate([x.reshape(-1) for x in sub])
             )
-            red = _compressed_allreduce(
-                flat, axis_name, site=site, wire_dtype=wire_dtype
-            )
+            if hierarchical:
+                red = hierarchical_psum(
+                    flat, axes, site=site, bucket_bytes=None,
+                    compress_intra=compress_intra, compress_dcn=compress_dcn,
+                    wire_dtype=wire_dtype,
+                )
+            else:
+                red = _compressed_allreduce(
+                    flat, axis_name, site=site, wire_dtype=wire_dtype
+                )
             off = 0
             for i, x in zip(group, sub):
                 sz = int(np.prod(jnp.shape(x))) or 1
                 piece = jax.lax.slice_in_dim(red, off, off + sz)
                 out[i] = piece.reshape(jnp.shape(x)).astype(dt)
                 off += sz
+        elif axes is not None:
+            red = _chained_psum(tuple(sub), axes, site=site)
+            for i, r in zip(group, red):
+                out[i] = r
         else:
             red = comms.psum(tuple(sub), axis_name, site=site)
             for i, r in zip(group, red):
@@ -488,20 +847,54 @@ class BucketedReduce:
 
     ``bucket_bytes=None`` disables splitting (monolithic collectives);
     ``compress=True`` turns on wire-dtype compression with fp32
-    accumulation."""
+    accumulation. ``hierarchical=True`` (needs a two-level
+    ``(slice, intra)`` ``axis_name``) routes reduces through the two-level
+    engines — ``compress_intra``/``compress_dcn`` then compress each tier
+    independently (both default to ``compress`` when left ``None``)."""
 
-    axis_name: str = DATA_AXIS
+    axis_name: Any = DATA_AXIS
     bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES
     compress: bool = False
     wire_dtype: Any = jnp.bfloat16
+    hierarchical: bool = False
+    compress_intra: Optional[bool] = None
+    compress_dcn: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.hierarchical and hierarchical_axes(self.axis_name) is None:
+            raise ValueError(
+                "hierarchical=True needs a (slice, intra) axis spec; got "
+                f"{self.axis_name!r}"
+            )
+
+    def _tier_compress(self) -> Tuple[bool, bool]:
+        ci = self.compress if self.compress_intra is None else (
+            self.compress_intra
+        )
+        cd = self.compress if self.compress_dcn is None else self.compress_dcn
+        return ci, cd
 
     def psum(self, flat, *, site: str = "bucketed.psum"):
+        if self.hierarchical:
+            ci, cd = self._tier_compress()
+            return hierarchical_psum(
+                flat, hierarchical_axes(self.axis_name), site=site,
+                bucket_bytes=self.bucket_bytes, compress_intra=ci,
+                compress_dcn=cd, wire_dtype=self.wire_dtype,
+            )
         return bucketed_psum(
             flat, self.axis_name, site=site, bucket_bytes=self.bucket_bytes,
             compress=self.compress, wire_dtype=self.wire_dtype,
         )
 
     def psum_scatter(self, flat, *, site: str = "bucketed.psum_scatter"):
+        if self.hierarchical:
+            ci, cd = self._tier_compress()
+            return hierarchical_psum_scatter(
+                flat, hierarchical_axes(self.axis_name), site=site,
+                bucket_bytes=self.bucket_bytes, compress_intra=ci,
+                compress_dcn=cd, wire_dtype=self.wire_dtype,
+            )
         return bucketed_psum_scatter(
             flat, self.axis_name, site=site, bucket_bytes=self.bucket_bytes,
             compress=self.compress, wire_dtype=self.wire_dtype,
@@ -511,16 +904,23 @@ class BucketedReduce:
         self, shard, *, site: str = "bucketed.all_gather",
         logical_dtype: Any = None,
     ):
+        if self.hierarchical:
+            return hierarchical_all_gather(
+                shard, hierarchical_axes(self.axis_name), site=site,
+                bucket_bytes=self.bucket_bytes, logical_dtype=logical_dtype,
+            )
         return bucketed_all_gather(
             shard, self.axis_name, site=site,
             bucket_bytes=self.bucket_bytes, logical_dtype=logical_dtype,
         )
 
     def tree_psum(self, leaves, *, site: str = "bucketed.tree_psum"):
+        ci, cd = self._tier_compress()
         return bucketed_tree_psum(
             leaves, self.axis_name, site=site,
             bucket_bytes=self.bucket_bytes, compress=self.compress,
-            wire_dtype=self.wire_dtype,
+            wire_dtype=self.wire_dtype, hierarchical=self.hierarchical,
+            compress_intra=ci, compress_dcn=cd,
         )
 
     def n_buckets(self, n_elements: int, itemsize: int) -> int:

@@ -28,12 +28,19 @@ from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.monitor.spans import span
 from beforeholiday_tpu.ops.arena import PackedParams
 from beforeholiday_tpu.parallel import bucketing, overlap
-from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
+from beforeholiday_tpu.parallel.parallel_state import (
+    DATA_AXIS,
+    hierarchical_axes,
+)
 
 
-def _axis_size(axis_name: str):
+def _axis_size(axis_name: Any):
     """``jax.lax.axis_size`` where it exists (jax >= 0.6); the psum-of-ones
-    identity on older jax — same value, and XLA folds it to a constant."""
+    identity on older jax — same value, and XLA folds it to a constant.
+    A two-level ``("slice", "intra")`` spec is the product of its tiers."""
+    axes = hierarchical_axes(axis_name)
+    if axes is not None:
+        return _axis_size(axes[0]) * _axis_size(axes[1])
     size = getattr(jax.lax, "axis_size", None)
     if size is not None:
         return size(axis_name)
@@ -56,7 +63,7 @@ def _grad_fingerprint(grads: Any) -> jax.Array:
 def reduce_gradients(
     grads: Any,
     *,
-    axis_name: str = DATA_AXIS,
+    axis_name: Any = DATA_AXIS,
     gradient_average: bool = True,
     gradient_predivide_factor: Optional[float] = None,
     allreduce_always_fp32: bool = False,
@@ -64,6 +71,9 @@ def reduce_gradients(
     bucket_bytes: Optional[int] = None,
     compress: bool = False,
     wire_dtype: Any = jnp.bfloat16,
+    hierarchical: bool = False,
+    compress_intra: Optional[bool] = None,
+    compress_dcn: Optional[bool] = None,
 ) -> Any:
     """psum a gradient pytree over ``axis_name`` with apex's scaling options.
 
@@ -99,7 +109,25 @@ def reduce_gradients(
     accumulation — see ``bucketing.compression_error_bound`` for the analytic
     error bound. Default (``bucket_bytes=None, compress=False``) is the
     legacy per-leaf psum, unchanged.
+
+    ``hierarchical=True`` (needs a two-level ``("slice", "intra")``
+    ``axis_name``, see ``parallel_state.make_two_level_mesh``) reduces each
+    bucket with the two-level engine — intra-slice reduce-scatter, inter-slice
+    psum on 1/slice_size of the payload, intra-slice all-gather — so the slow
+    DCN tier carries ``1/slice_size`` of the flat bytes (the ledger's
+    ``comms_summary()['by_tier']`` proves it). Uncompressed it is
+    bitwise-equal to the flat bucketed path over the same two-level spec.
+    ``compress_intra`` / ``compress_dcn`` compress each tier independently
+    (``None`` inherits ``compress``); the composed analytic bound is
+    ``bucketing.hierarchical_compression_error_bound``.
     """
+    if hierarchical and hierarchical_axes(axis_name) is None:
+        raise ValueError(
+            "hierarchical=True needs a (slice, intra) axis spec; got "
+            f"{axis_name!r}"
+        )
+    ci = compress if compress_intra is None else compress_intra
+    cd = compress if compress_dcn is None else compress_dcn
     with span("ddp_reduce_gradients"):
         world = _axis_size(axis_name)
 
@@ -138,7 +166,7 @@ def reduce_gradients(
                 g = g.astype(orig_dtype)
             return g
 
-        bucketed = bucket_bytes is not None or compress
+        bucketed = bucket_bytes is not None or compress or hierarchical
         if not bucketed:
 
             def _reduce(g):
@@ -152,17 +180,32 @@ def reduce_gradients(
             reduced = jax.tree.map(_reduce, grads)
         elif isinstance(grads, PackedParams):
             # arena-native grads: bucket each flat arena directly
-            arenas = [
-                _post(
-                    bucketing.bucketed_psum(
-                        _pre(a), axis_name, site="ddp.bucketed_reduce",
-                        bucket_bytes=bucket_bytes, compress=compress,
-                        wire_dtype=wire_dtype,
-                    ),
-                    a.dtype,
-                )
-                for a in grads.arenas
-            ]
+            if hierarchical:
+                arenas = [
+                    _post(
+                        bucketing.hierarchical_psum(
+                            _pre(a), hierarchical_axes(axis_name),
+                            site="ddp.bucketed_reduce",
+                            bucket_bytes=bucket_bytes,
+                            compress_intra=ci, compress_dcn=cd,
+                            wire_dtype=wire_dtype,
+                        ),
+                        a.dtype,
+                    )
+                    for a in grads.arenas
+                ]
+            else:
+                arenas = [
+                    _post(
+                        bucketing.bucketed_psum(
+                            _pre(a), axis_name, site="ddp.bucketed_reduce",
+                            bucket_bytes=bucket_bytes, compress=compress,
+                            wire_dtype=wire_dtype,
+                        ),
+                        a.dtype,
+                    )
+                    for a in grads.arenas
+                ]
             reduced = grads.replace_arenas(arenas)
         else:
             leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -170,6 +213,8 @@ def reduce_gradients(
                 [_pre(g) for g in leaves], axis_name,
                 site="ddp.bucketed_reduce", bucket_bytes=bucket_bytes,
                 compress=compress, wire_dtype=wire_dtype,
+                hierarchical=hierarchical, compress_intra=ci,
+                compress_dcn=cd,
             )
             red = [_post(r, g.dtype) for r, g in zip(red, leaves)]
             reduced = jax.tree_util.tree_unflatten(treedef, red)
@@ -188,16 +233,27 @@ class Reducer:
 
     def __init__(
         self,
-        axis_name: str = DATA_AXIS,
+        axis_name: Any = DATA_AXIS,
         *,
         bucket_bytes: Optional[int] = None,
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
+        hierarchical: bool = False,
+        compress_intra: Optional[bool] = None,
+        compress_dcn: Optional[bool] = None,
     ):
+        if hierarchical and hierarchical_axes(axis_name) is None:
+            raise ValueError(
+                "hierarchical=True needs a (slice, intra) axis spec; got "
+                f"{axis_name!r}"
+            )
         self.axis_name = axis_name
         self.bucket_bytes = bucket_bytes
         self.compress = compress
         self.wire_dtype = wire_dtype
+        self.hierarchical = hierarchical
+        self.compress_intra = compress_intra
+        self.compress_dcn = compress_dcn
 
     def hook(self, tree: Any, *, tag: str = "reducer") -> Any:
         """Backward-time variant of :meth:`reduce`: identity on ``tree``
@@ -206,7 +262,9 @@ class Reducer:
         return overlap.hook_tree(
             tree, tag=tag, axis_name=self.axis_name,
             bucket_bytes=self.bucket_bytes, compress=self.compress,
-            wire_dtype=self.wire_dtype,
+            wire_dtype=self.wire_dtype, hierarchical=self.hierarchical,
+            compress_intra=self.compress_intra,
+            compress_dcn=self.compress_dcn,
         )
 
     def broadcast_params(self, params: Any) -> Any:
@@ -230,7 +288,9 @@ class Reducer:
         return reduce_gradients(
             tree, axis_name=self.axis_name, gradient_average=average,
             bucket_bytes=self.bucket_bytes, compress=self.compress,
-            wire_dtype=self.wire_dtype,
+            wire_dtype=self.wire_dtype, hierarchical=self.hierarchical,
+            compress_intra=self.compress_intra,
+            compress_dcn=self.compress_dcn,
         )
 
 
@@ -251,7 +311,7 @@ class DistributedDataParallel:
     def __init__(
         self,
         *,
-        axis_name: str = DATA_AXIS,
+        axis_name: Any = DATA_AXIS,
         gradient_average: bool = True,
         gradient_predivide_factor: Optional[float] = None,
         allreduce_always_fp32: bool = False,
@@ -259,7 +319,15 @@ class DistributedDataParallel:
         compress: bool = False,
         wire_dtype: Any = jnp.bfloat16,
         overlap_backward: bool = False,
+        hierarchical: bool = False,
+        compress_intra: Optional[bool] = None,
+        compress_dcn: Optional[bool] = None,
     ):
+        if hierarchical and hierarchical_axes(axis_name) is None:
+            raise ValueError(
+                "hierarchical=True needs a (slice, intra) axis spec; got "
+                f"{axis_name!r}"
+            )
         self.axis_name = axis_name
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
@@ -268,6 +336,9 @@ class DistributedDataParallel:
         self.compress = compress
         self.wire_dtype = wire_dtype
         self.overlap_backward = overlap_backward
+        self.hierarchical = hierarchical
+        self.compress_intra = compress_intra
+        self.compress_dcn = compress_dcn
 
     def reduce(self, grads: Any) -> Any:
         return reduce_gradients(
@@ -279,6 +350,9 @@ class DistributedDataParallel:
             bucket_bytes=self.bucket_bytes,
             compress=self.compress,
             wire_dtype=self.wire_dtype,
+            hierarchical=self.hierarchical,
+            compress_intra=self.compress_intra,
+            compress_dcn=self.compress_dcn,
         )
 
     def hook(self, tree: Any, *, tag: str = "ddp") -> Any:
@@ -292,7 +366,9 @@ class DistributedDataParallel:
             gradient_predivide_factor=self.gradient_predivide_factor,
             allreduce_always_fp32=self.allreduce_always_fp32,
             bucket_bytes=self.bucket_bytes, compress=self.compress,
-            wire_dtype=self.wire_dtype,
+            wire_dtype=self.wire_dtype, hierarchical=self.hierarchical,
+            compress_intra=self.compress_intra,
+            compress_dcn=self.compress_dcn,
         )
 
     def value_and_grad(

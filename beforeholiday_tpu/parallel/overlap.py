@@ -56,7 +56,10 @@ from beforeholiday_tpu.monitor import comms
 from beforeholiday_tpu.monitor.spans import span
 from beforeholiday_tpu.ops.arena import PackedParams
 from beforeholiday_tpu.parallel import bucketing
-from beforeholiday_tpu.parallel.parallel_state import DATA_AXIS
+from beforeholiday_tpu.parallel.parallel_state import (
+    DATA_AXIS,
+    hierarchical_axes,
+)
 
 __all__ = [
     "fold_found_inf",
@@ -66,10 +69,13 @@ __all__ = [
 ]
 
 
-def _axis_size(axis_name: str):
+def _axis_size(axis_name: Any):
     """Same compat shim as ``distributed._axis_size`` (not imported from
     there: ``distributed`` imports this module, and the hook must reproduce
     the sweep's op sequence byte for byte anyway)."""
+    axes = hierarchical_axes(axis_name)
+    if axes is not None:
+        return _axis_size(axes[0]) * _axis_size(axes[1])
     size = getattr(jax.lax, "axis_size", None)
     if size is not None:
         return size(axis_name)
@@ -79,7 +85,7 @@ def _axis_size(axis_name: str):
 def _reduce_cotangent(
     ct: Any,
     *,
-    axis_name: str,
+    axis_name: Any,
     site: str,
     gradient_average: bool,
     gradient_predivide_factor: Optional[float],
@@ -87,6 +93,9 @@ def _reduce_cotangent(
     bucket_bytes: Optional[int],
     compress: bool,
     wire_dtype: Any,
+    hierarchical: bool = False,
+    compress_intra: bool = False,
+    compress_dcn: bool = False,
 ) -> Any:
     """The body of ``distributed.reduce_gradients`` minus the tripwire —
     the identical pre-scale / reduce / post-scale op sequence, so the hooked
@@ -110,7 +119,7 @@ def _reduce_cotangent(
             g = g.astype(orig_dtype)
         return g
 
-    bucketed = bucket_bytes is not None or compress
+    bucketed = bucket_bytes is not None or compress or hierarchical
     if not bucketed:
 
         def _reduce(g):
@@ -118,22 +127,38 @@ def _reduce_cotangent(
 
         return jax.tree.map(_reduce, ct)
     if isinstance(ct, PackedParams):
-        arenas = [
-            _post(
-                bucketing.bucketed_psum(
-                    _pre(a), axis_name, site=site,
-                    bucket_bytes=bucket_bytes, compress=compress,
-                    wire_dtype=wire_dtype,
-                ),
-                a.dtype,
-            )
-            for a in ct.arenas
-        ]
+        if hierarchical:
+            arenas = [
+                _post(
+                    bucketing.hierarchical_psum(
+                        _pre(a), hierarchical_axes(axis_name), site=site,
+                        bucket_bytes=bucket_bytes,
+                        compress_intra=compress_intra,
+                        compress_dcn=compress_dcn, wire_dtype=wire_dtype,
+                    ),
+                    a.dtype,
+                )
+                for a in ct.arenas
+            ]
+        else:
+            arenas = [
+                _post(
+                    bucketing.bucketed_psum(
+                        _pre(a), axis_name, site=site,
+                        bucket_bytes=bucket_bytes, compress=compress,
+                        wire_dtype=wire_dtype,
+                    ),
+                    a.dtype,
+                )
+                for a in ct.arenas
+            ]
         return ct.replace_arenas(arenas)
     leaves, treedef = jax.tree_util.tree_flatten(ct)
     red = bucketing.bucketed_tree_psum(
         [_pre(g) for g in leaves], axis_name, site=site,
         bucket_bytes=bucket_bytes, compress=compress, wire_dtype=wire_dtype,
+        hierarchical=hierarchical, compress_intra=compress_intra,
+        compress_dcn=compress_dcn,
     )
     red = [_post(r, g.dtype) for r, g in zip(red, leaves)]
     return jax.tree_util.tree_unflatten(treedef, red)
@@ -141,7 +166,7 @@ def _reduce_cotangent(
 
 @functools.lru_cache(maxsize=None)
 def _hook_fn(
-    axis_name: str,
+    axis_name: Any,
     tag: str,
     gradient_average: bool,
     gradient_predivide_factor: Optional[float],
@@ -149,6 +174,9 @@ def _hook_fn(
     bucket_bytes: Optional[int],
     compress: bool,
     wire_dtype_name: str,
+    hierarchical: bool = False,
+    compress_intra: bool = False,
+    compress_dcn: bool = False,
 ) -> Callable[[Any], Any]:
     """One cached ``custom_vjp`` identity per hashable reduction config.
 
@@ -178,6 +206,9 @@ def _hook_fn(
                     bucket_bytes=bucket_bytes,
                     compress=compress,
                     wire_dtype=wire_dtype,
+                    hierarchical=hierarchical,
+                    compress_intra=compress_intra,
+                    compress_dcn=compress_dcn,
                 ),
             )
 
@@ -188,7 +219,7 @@ def _hook_fn(
 def reduction_hook(
     tree: Any,
     *,
-    axis_name: str = DATA_AXIS,
+    axis_name: Any = DATA_AXIS,
     tag: str = "grads",
     gradient_average: bool = True,
     gradient_predivide_factor: Optional[float] = None,
@@ -196,6 +227,9 @@ def reduction_hook(
     bucket_bytes: Optional[int] = None,
     compress: bool = False,
     wire_dtype: Any = jnp.bfloat16,
+    hierarchical: bool = False,
+    compress_intra: Optional[bool] = None,
+    compress_dcn: Optional[bool] = None,
 ) -> Any:
     """Identity on ``tree`` whose backward reduces the cotangent in place.
 
@@ -214,12 +248,20 @@ def reduction_hook(
     result is bitwise-equal to reducing the stacked grads afterwards —
     psum is elementwise over the leading layer axis).
 
-    Scaling knobs mirror ``reduce_gradients`` exactly; must run inside a
-    binding context for ``axis_name`` with varying-axis tracking off (see
+    Scaling knobs mirror ``reduce_gradients`` exactly — including the
+    two-level ``hierarchical`` / ``compress_intra`` / ``compress_dcn`` knobs
+    (``None`` tier knobs inherit ``compress``); must run inside a binding
+    context for ``axis_name`` with varying-axis tracking off (see
     ``reduce_gradients``'s docstring).
     """
+    axes = hierarchical_axes(axis_name)
+    if hierarchical and axes is None:
+        raise ValueError(
+            "hierarchical=True needs a (slice, intra) axis spec; got "
+            f"{axis_name!r}"
+        )
     fn = _hook_fn(
-        axis_name,
+        axes if axes is not None else axis_name,
         tag,
         bool(gradient_average),
         None if gradient_predivide_factor is None
@@ -228,6 +270,9 @@ def reduction_hook(
         None if bucket_bytes is None else int(bucket_bytes),
         bool(compress),
         jnp.dtype(wire_dtype).name,
+        bool(hierarchical),
+        bool(compress if compress_intra is None else compress_intra),
+        bool(compress if compress_dcn is None else compress_dcn),
     )
     return fn(tree)
 

@@ -36,6 +36,23 @@ CONTEXT_AXIS = "context"
 
 MESH_AXIS_NAMES = (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
 
+# Two-level data-parallel convention for DCN-scale meshes: the flat data axis
+# splits into a slow inter-slice tier and a fast on-slice tier,
+#
+#     mesh shape = (slice, intra)        rank r = slice * slice_size + intra
+#
+# mirroring how the reference builds a second set of allreduce communicators
+# for the inter-node tier (apex DistributedFusedAdam
+# ``allreduce_communicators`` / NCCL tree hierarchies). A collective over the
+# pair ``(SLICE_AXIS, INTRA_AXIS)`` is the flat reduce; the hierarchical
+# engines in ``parallel/bucketing.py`` decompose it so only 1/slice_size of
+# the payload crosses SLICE_AXIS. Collectives over SLICE_AXIS are booked on
+# the "dcn" tier of the comms ledger (monitor/comms.py DCN_AXES).
+SLICE_AXIS = "slice"
+INTRA_AXIS = "intra"
+
+HIERARCHICAL_AXES = (SLICE_AXIS, INTRA_AXIS)
+
 
 @dataclasses.dataclass(frozen=True)
 class ParallelState:
@@ -348,3 +365,70 @@ def named_sharding(*spec) -> NamedSharding:
 def data_parallel_spec(ndim: int) -> PartitionSpec:
     """Shard the leading (batch) dim over the data axis, replicate the rest."""
     return PartitionSpec(DATA_AXIS, *([None] * (ndim - 1)))
+
+
+# --- two-level (multi-slice) mesh helpers ---------------------------------------------
+#
+# Flat-axis behavior is untouched: every helper below only engages when the
+# caller hands an explicit (slice, intra) pair; a plain string axis keeps the
+# single-tier semantics everywhere else in the library.
+
+
+def hierarchical_axes(axis_name):
+    """Normalize an axis spec into a ``(slice_axis, intra_axis)`` pair, or
+    ``None`` when the spec is a flat single axis.
+
+    The two-level engines accept either a plain axis name (flat, no slice
+    tier) or a 2-sequence ``(slow, fast)`` ordered slowest-tier first — the
+    ``HIERARCHICAL_AXES`` convention. Anything longer is rejected: deeper
+    hierarchies (e.g. pod > superpod > slice) would need per-tier knobs this
+    library does not model yet."""
+    if isinstance(axis_name, (tuple, list)):
+        if len(axis_name) == 1:
+            return None
+        if len(axis_name) != 2:
+            raise ValueError(
+                "a hierarchical axis spec must be (slice_axis, intra_axis); "
+                f"got {tuple(axis_name)!r}"
+            )
+        return (str(axis_name[0]), str(axis_name[1]))
+    return None
+
+
+def make_two_level_mesh(
+    n_slices: int,
+    slice_size: Optional[int] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(slice, intra)`` mesh: ``n_slices`` slices of ``slice_size``
+    devices each, slice-major so the flat data-parallel rank is
+    ``slice * slice_size + intra`` (the same rank order a flat ``(data,)``
+    mesh over the identical device list would produce — flat and
+    hierarchical collectives then scatter/gather identical shards).
+
+    ``slice_size`` defaults to ``len(devices) // n_slices``. This does NOT
+    install global parallel state (it is a data-parallel-only view for the
+    DDP/ZeRO engines); compose with ``initialize_model_parallel`` meshes by
+    hand when model parallelism is also in play."""
+    if devices is None:
+        devices = jax.devices()
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    if slice_size is None:
+        if len(devices) % n_slices != 0:
+            raise RuntimeError(
+                f"device count ({len(devices)}) is not divisible by "
+                f"n_slices ({n_slices})"
+            )
+        slice_size = len(devices) // n_slices
+    world = n_slices * slice_size
+    if len(devices) < world:
+        raise RuntimeError(
+            f"need {world} devices for a {n_slices}x{slice_size} mesh, "
+            f"have {len(devices)}"
+        )
+    dev_array = np.asarray(devices[:world], dtype=object).reshape(
+        n_slices, slice_size
+    )
+    return Mesh(dev_array, HIERARCHICAL_AXES)

@@ -19,7 +19,7 @@ prefetch depth chain) but burns neither engine's time.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, FrozenSet, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +29,7 @@ __all__ = [
     "COLLECTIVES",
     "Engines",
     "bitwise_equal",
+    "eqn_axis_names",
     "replay",
     "replay_fn",
 ]
@@ -42,6 +43,12 @@ MEM_US = 5e-4
 WIRE_US = 4e-3
 WIRE_LAT_US = 2.0
 MIN_US = 1e-3
+
+# the slow inter-slice tier: a collective whose axes touch ``dcn_axes`` pays
+# these instead — 10x the ICI wire on both per-byte and launch cost, the
+# bandwidth cliff the hierarchical engines exist to sidestep
+DCN_WIRE_US = 4e-2
+DCN_LAT_US = 20.0
 
 COLLECTIVES = frozenset({
     "psum", "pmax", "pmin", "ppermute", "all_gather", "psum_scatter",
@@ -105,6 +112,17 @@ def _dot_flops(eqn) -> float:
     return 2.0 * bsize * m * n * csize
 
 
+def eqn_axis_names(eqn) -> tuple:
+    """Mesh axis names a collective eqn runs over: ``psum``-family carries
+    ``axes``, the data movers (``all_gather`` / ``psum_scatter`` /
+    ``all_to_all`` / ``ppermute``) carry ``axis_name``. Either may be one
+    name or a tuple; normalized to a flat tuple of names."""
+    spec = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(spec, (tuple, list)):
+        return tuple(spec)
+    return (spec,)
+
+
 def _sub_jaxpr(eqn):
     """The inlineable sub-jaxpr of a call-like eqn (pjit / closed_call /
     custom_vjp remnants / shard_map / remat), or None. Only taken when the
@@ -121,8 +139,19 @@ def _sub_jaxpr(eqn):
     return None
 
 
-def replay(jaxpr, in_times: List[float], eng: Engines) -> List[float]:
-    """Program-order dual-engine replay of one (open) jaxpr."""
+def replay(
+    jaxpr,
+    in_times: List[float],
+    eng: Engines,
+    dcn_axes: Optional[FrozenSet[str]] = None,
+) -> List[float]:
+    """Program-order dual-engine replay of one (open) jaxpr.
+
+    ``dcn_axes`` names the slow-tier mesh axes: a collective touching any of
+    them is costed at DCN rates (``DCN_WIRE_US``/``DCN_LAT_US``) instead of
+    ICI — how the multislice bench taxes inter-slice hops before any
+    multi-slice hardware exists."""
+    dcn_axes = frozenset() if dcn_axes is None else frozenset(dcn_axes)
     env: Dict[Any, float] = {}
     for v, t in zip(jaxpr.invars, in_times):
         env[v] = t
@@ -159,7 +188,7 @@ def replay(jaxpr, in_times: List[float], eng: Engines) -> List[float]:
             xs_t = [get(v) for v in eqn.invars[nc + ncar:]]
             ys_t: List[float] = [0.0] * (len(eqn.outvars) - ncar)
             for _ in range(length):
-                outs = replay(body, const_t + carry_t + xs_t, eng)
+                outs = replay(body, const_t + carry_t + xs_t, eng, dcn_axes)
                 carry_t = outs[:ncar]
                 ys_t = outs[ncar:]  # stacked ys ready at the last producer
             for v, t in zip(eqn.outvars, carry_t + ys_t):
@@ -167,13 +196,19 @@ def replay(jaxpr, in_times: List[float], eng: Engines) -> List[float]:
             continue
         sub = _sub_jaxpr(eqn)
         if sub is not None:
-            outs = replay(sub, [get(v) for v in eqn.invars], eng)
+            outs = replay(sub, [get(v) for v in eqn.invars], eng, dcn_axes)
             for v, t in zip(eqn.outvars, outs):
                 env[v] = t
             continue
         ready = max([get(v) for v in eqn.invars], default=0.0)
         if name in COLLECTIVES:
-            dur = WIRE_LAT_US + _out_bytes(eqn) * WIRE_US
+            slow = dcn_axes and any(
+                a in dcn_axes for a in eqn_axis_names(eqn)
+            )
+            if slow:
+                dur = DCN_LAT_US + _out_bytes(eqn) * DCN_WIRE_US
+            else:
+                dur = WIRE_LAT_US + _out_bytes(eqn) * WIRE_US
             end = eng.run("comms", f"{name}:replay", ready, dur)
         else:
             if name == "dot_general":
@@ -186,14 +221,17 @@ def replay(jaxpr, in_times: List[float], eng: Engines) -> List[float]:
     return [get(v) for v in jaxpr.outvars]
 
 
-def replay_fn(fn, *args) -> Dict[str, Any]:
+def replay_fn(
+    fn, *args, dcn_axes: Optional[FrozenSet[str]] = None
+) -> Dict[str, Any]:
     """Trace ``fn`` and replay it: makespan, events (with a wrapping step
-    span), and the achieved overlap_report fraction."""
+    span), and the achieved overlap_report fraction. ``dcn_axes`` taxes
+    collectives over those mesh axes at DCN rates (see :func:`replay`)."""
     from beforeholiday_tpu.monitor import overlap as mon_overlap
 
     closed = jax.make_jaxpr(fn)(*args)
     eng = Engines()
-    replay(closed.jaxpr, [0.0] * len(closed.jaxpr.invars), eng)
+    replay(closed.jaxpr, [0.0] * len(closed.jaxpr.invars), eng, dcn_axes)
     makespan = eng.makespan()
     events = (
         [{"ph": "B", "name": "step", "pid": 0, "tid": 2, "ts": 0.0}]

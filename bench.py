@@ -1025,6 +1025,35 @@ def bench_zero3():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_multislice():
+    """Two-level hierarchical collectives on the 2-slice x 4-rank carve of
+    the virtual 8-CPU mesh. The child pins the hierarchical DDP reduce and a
+    2-step hierarchical ZeRO-2 run bitwise against the flat engines, then
+    derives the gated keys from measurements: ``hier_dcn_bytes_ratio`` is
+    the ledger-booked flat/hierarchical DCN byte quotient (must equal the
+    slice size exactly on the aligned payload) and ``hier_vs_flat_makespan``
+    the dual-engine replay ratio with the slice axis taxed at DCN rates
+    (strictly below 1, asserted in the child). Same env scrub as
+    ``bench_pp_overhead``."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.multislice_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"multislice_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_quantized():
     """O6 quantized-tier rungs on a CPU subprocess. The child pins the
     per-matmul quantized_matmul error inside its analytic bound, steps O5 and
@@ -1486,6 +1515,28 @@ def main():
             "before anything prints"
         )
         pass2.update(z3.get("pass2") or {})
+
+    # --- two-level hierarchical collectives (2x4 slice carve, subprocess) ---
+    ms = _stage(detail, bench_multislice)
+    if ms:
+        for k in ("hier_dcn_bytes_ratio", "hier_vs_flat_makespan",
+                  "hier_dcn_bytes", "flat_dcn_bytes",
+                  "hier_dcn_compression_ratio", "hier_ici_compression_ratio"):
+            detail[k] = ms.get(k)
+        detail["multislice_bench"] = {
+            k: v for k, v in ms.items()
+            if k not in ("pass2", "compile_counters")
+        }
+        detail["multislice_note"] = (
+            "2-slice x 4-rank carve of the 8-CPU mesh: the DCN byte ratio is "
+            "the ledger-booked flat/hierarchical quotient on the slow tier "
+            "(== slice_size exactly on the aligned payload), the makespan "
+            "ratio a deterministic dual-engine replay with the slice axis "
+            "taxed at 10x ICI rates; numerics are pinned bitwise against the "
+            "flat DDP reduce and a 2-step flat ZeRO-2 run in the child "
+            "before anything prints"
+        )
+        pass2.update(ms.get("pass2") or {})
 
     # --- O6 quantized-tier parity + dispatch honesty (CPU subprocess) ---
     qz = _stage(detail, bench_quantized)

@@ -286,6 +286,27 @@ def test_zero3_engine_is_scanned():
     assert not any(path.startswith("optimizers/") for path, _ in _WAIVED)
 
 
+def test_multislice_surface_is_scanned():
+    """The two-level hierarchical engine promises static tier geometry with
+    no readbacks: ``_sized_axes``/``static_axis_size`` resolve slice/intra
+    sizes at trace time, and the per-tier ledger books while XLA builds the
+    program. Pin that its whole surface — the mesh helpers, the two-level
+    bucketing engines, and the tier-aware ledger — sits inside the
+    scanner's reach with ZERO file-scoped sanctions and ZERO waivers, so a
+    future ``int()`` on a traced axis index in the scatter leg fails
+    loudly."""
+    for rel in (
+        "parallel/parallel_state.py",
+        "parallel/bucketing.py",
+        "parallel/distributed.py",
+        "monitor/comms.py",
+    ):
+        assert (_PKG_ROOT / rel).is_file(), rel
+        assert pathlib.Path(rel).parts[0] not in _SKIP_DIRS
+        assert rel not in _SANCTIONED_BY_FILE
+        assert not any(path == rel for path, _ in _WAIVED)
+
+
 def test_quantized_tier_is_scanned():
     """The O6 tier is hot-path-only by construction: ops/quantized.py keeps
     every amax/scale decision device-side (its docstring's tracer-hygiene
