@@ -1,0 +1,48 @@
+"""Elastic training: async shard checkpoints, live resharding, preemption
+survival.
+
+Production TPU jobs get preempted, lose hosts, and resume at different
+world sizes. This package composes the repo's shipped mechanisms — ZeRO-3
+per-rank shard checkpoints with bitwise resharding, StepGuard health state,
+the replication tripwire, and the flight recorder — into a survivable loop:
+
+* :class:`~beforeholiday_tpu.elastic.checkpoint.CheckpointManager` — async
+  overlapped generation checkpoints (non-blocking device→host snapshot,
+  background serialize + atomic write, bounded-queue backpressure), every
+  stall booked to the ``ckpt`` ledger (:func:`ckpt_summary`).
+* :class:`~beforeholiday_tpu.elastic.trainer.ElasticTrainer` — the loop
+  that treats a tripwire mismatch or a (simulated) preemption as a resize
+  event: drain, reload the last durable manifest, ``reshard_state`` to the
+  surviving world on a freshly carved mesh, continue bitwise.
+
+Drills live in ``testing/elastic_bench.py`` (SIGKILL a training subprocess
+mid-run, assert bitwise-correct resume) and ``tests/test_elastic.py``.
+"""
+
+from beforeholiday_tpu.elastic.checkpoint import (
+    CheckpointManager,
+    ckpt_records,
+    ckpt_summary,
+    latest_generation,
+    list_generations,
+    reset_ckpt_ledger,
+)
+from beforeholiday_tpu.elastic.trainer import (
+    ElasticTrainer,
+    ResizeEvent,
+    guard_state_specs,
+    zero3_state_specs,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "ElasticTrainer",
+    "ResizeEvent",
+    "ckpt_records",
+    "ckpt_summary",
+    "guard_state_specs",
+    "latest_generation",
+    "list_generations",
+    "reset_ckpt_ledger",
+    "zero3_state_specs",
+]

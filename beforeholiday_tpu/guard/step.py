@@ -318,6 +318,92 @@ class StepGuard:
 
         return new_params, new_opt_state, new_state
 
+    def apply_sharded_update(
+        self,
+        opt,
+        state,
+        grads,
+        gstate,
+        verdict: Dict[str, jax.Array],
+        *,
+        grad_scale=1.0,
+        extra_found_inf=None,
+        **opt_kw,
+    ):
+        """:meth:`apply_update` for the ZeRO-3 shard triplet. Returns
+        ``(state, gstate)``.
+
+        The fully-sharded optimizer folds params INTO its state
+        (``ZeRO3FusedAdam.step(grads, state) -> state`` where ``state`` holds
+        the ``master``/``exp_avg``/``exp_avg_sq`` arenas plus the step
+        counter), so there is no separate ``params`` to guard: the sentinel
+        screens the updated ``master`` arena, reverts/rolls back the WHOLE
+        triplet, and the rollback snapshot (seeded by ``guard.init(state)``)
+        is shard-sized — it scales with 1/world like everything else in the
+        ZeRO-3 memory budget. Ordering, scale update, and health bookkeeping
+        are identical to :meth:`apply_update`; the elastic checkpoint carries
+        the resulting ``gstate`` through :meth:`state_dict` so a resharded
+        resume continues the exact scale/health trajectory.
+        """
+        pre_inf = verdict["grad_overflow"] | verdict["loss_nonfinite"]
+        if extra_found_inf is not None:
+            pre_inf = pre_inf | (jnp.asarray(extra_found_inf) != 0)
+        new_state = opt.step(
+            grads, state, found_inf=pre_inf, grad_scale=grad_scale, **opt_kw
+        )
+
+        param_bad = jnp.bool_(False)
+        if self.check_params:
+            param_bad = _tree_nonfinite(new_state["master"]) & ~pre_inf
+            new_state = _tree_select(param_bad, state, new_state)
+        skip = pre_inf | param_bad
+
+        sstate = self.scaler.update(
+            gstate["scaler"], skip, amax=verdict.get("amax")
+        )
+        consec = sstate.get(
+            "consecutive_overflows",
+            jnp.where(skip, gstate["health"]["consecutive_overflows"] + 1, 0),
+        )
+
+        reason_now = jnp.where(
+            verdict["loss_nonfinite"],
+            SKIP_LOSS_NONFINITE,
+            jnp.where(
+                verdict["grad_overflow"], SKIP_GRAD_OVERFLOW, SKIP_PARAM_NONFINITE
+            ),
+        )
+        health = dict(gstate["health"])
+        health["skipped_total"] = health["skipped_total"] + skip.astype(jnp.int32)
+        health["last_skip_reason"] = jnp.where(
+            skip, reason_now, health["last_skip_reason"]
+        ).astype(jnp.int32)
+
+        new_gstate = {"scaler": sstate, "health": health}
+        if self.rollback_after:
+            snapshot = gstate["snapshot"]
+            trigger = (
+                skip
+                & (consec >= self.rollback_after)
+                & self.scaler.at_min_scale(sstate)
+            )
+            new_state = _tree_select(trigger, snapshot, new_state)
+            new_gstate["snapshot"] = _tree_select(skip, snapshot, new_state)
+            consec = jnp.where(trigger, 0, consec)
+            if "consecutive_overflows" in sstate:
+                sstate = dict(sstate)
+                sstate["consecutive_overflows"] = jnp.asarray(consec, jnp.int32)
+                new_gstate["scaler"] = sstate
+            health["rollbacks_total"] = (
+                health["rollbacks_total"] + trigger.astype(jnp.int32)
+            )
+            health["last_skip_reason"] = jnp.where(
+                trigger, SKIP_ROLLBACK, health["last_skip_reason"]
+            ).astype(jnp.int32)
+        health["consecutive_overflows"] = jnp.asarray(consec, jnp.int32)
+
+        return new_state, new_gstate
+
     # --- checkpointing ----------------------------------------------------------
     #
     # Host-side by contract, like the scaler's (ref: apex/amp/frontend.py:434-473)

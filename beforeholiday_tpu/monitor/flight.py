@@ -20,6 +20,11 @@ Two triggers turn the ring into an artifact:
   ``sys.excepthook`` (dump first, then the previous hook); using the
   recorder as a context manager dumps on the way out of a raising block and
   disarms on clean exit.
+* **Preemption notice (opt-in)** — :meth:`arm_preemption_dump` installs a
+  SIGTERM handler that dumps the ring plus the last durable checkpoint
+  generation (:meth:`note_checkpoint`, stamped by the elastic
+  ``CheckpointManager``) and then re-delivers the signal, so the process
+  still dies a signal death after the black box is on disk.
 
 Usage::
 
@@ -35,6 +40,8 @@ from __future__ import annotations
 
 import collections
 import json
+import os
+import signal as _signal
 import sys
 import threading
 import time
@@ -100,6 +107,9 @@ class FlightRecorder:
         self._prev_rollbacks: Optional[float] = None
         self._prev_hook = None
         self._armed = False
+        self._last_checkpoint: Optional[Dict[str, Any]] = None
+        self._sig_prev = None   # previous disposition while preemption-armed
+        self._sig_num: Optional[int] = None
 
     # ------------------------------------------------------------- recording
     def record(
@@ -145,6 +155,19 @@ class FlightRecorder:
         metrics_logger.callback = _cb
         return self
 
+    def note_checkpoint(self, generation: int,
+                        path: Optional[str] = None) -> None:
+        """Record the last DURABLE checkpoint generation (the elastic
+        ``CheckpointManager`` calls this as each generation lands). Rides
+        every dump as ``last_checkpoint`` — a preemption dump thereby names
+        exactly where the resumed run will pick up."""
+        with self._lock:
+            self._last_checkpoint = {
+                "generation": int(generation),
+                "path": path,
+                "noted_unix": time.time(),
+            }
+
     # -------------------------------------------------------------- queries
     def snapshots(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -169,10 +192,15 @@ class FlightRecorder:
         from beforeholiday_tpu.guard.dispatch import probe_failures
 
         snaps = self.snapshots()
+        with self._lock:
+            last_ckpt = (
+                dict(self._last_checkpoint) if self._last_checkpoint else None
+            )
         payload: Dict[str, Any] = {
             "reason": reason,
             "created_unix": time.time(),
             "capacity": self.capacity,
+            "last_checkpoint": last_ckpt,
             "n_snapshots": len(snaps),
             "snapshots": snaps,
             "loss_scale_trajectory": [
@@ -218,6 +246,55 @@ class FlightRecorder:
         sys.excepthook = _hook
         self._armed = True
         return self
+
+    def arm_preemption_dump(self, signum: int = _signal.SIGTERM
+                            ) -> "FlightRecorder":
+        """Opt-in preemption hook: install a handler for ``signum`` (default
+        SIGTERM — the shape of a cloud preemption notice) that dumps the
+        black box (``reason="preemption:<SIGNAME>"``, including the last
+        checkpoint generation from :meth:`note_checkpoint`) and then
+        RE-DELIVERS the signal under the previous disposition — the process
+        still dies a signal death (exit 143 for SIGTERM), so supervisors
+        see the truthful status instead of a masked clean exit. Main thread
+        only (``signal.signal``'s contract); idempotent;
+        :meth:`disarm_preemption_dump` restores."""
+        if self._sig_num is not None:
+            return self
+
+        def _handler(s, frame):
+            try:
+                name = _signal.Signals(s).name
+            except ValueError:  # pragma: no cover — exotic signum
+                name = str(s)
+            try:
+                self.dump(reason=f"preemption:{name}")
+            except Exception:  # noqa: BLE001 — never mask the signal
+                logger.exception(
+                    "flight-recorder dump failed in preemption handler"
+                )
+            prev = self._sig_prev
+            self._sig_num = None
+            self._sig_prev = None
+            _signal.signal(
+                s, prev if prev is not None else _signal.SIG_DFL
+            )
+            os.kill(os.getpid(), s)
+
+        self._sig_prev = _signal.signal(signum, _handler)
+        self._sig_num = signum
+        return self
+
+    def disarm_preemption_dump(self) -> None:
+        """Restore the previous disposition for the armed signal (no-op when
+        not armed)."""
+        if self._sig_num is None:
+            return
+        prev = self._sig_prev
+        _signal.signal(
+            self._sig_num, prev if prev is not None else _signal.SIG_DFL
+        )
+        self._sig_num = None
+        self._sig_prev = None
 
     def disarm_crash_dump(self) -> None:
         """Restore the previous excepthook (only if ours is still

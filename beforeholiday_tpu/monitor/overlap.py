@@ -42,10 +42,13 @@ __all__ = [
 
 # Span/instant name prefixes that mean "this is wire time": the comms ledger
 # mirrors records as "<kind>:<site>" and the overlap engine's own spans use a
-# plain "comms" prefix.
+# plain "comms" prefix. "ckpt" covers the elastic checkpoint phases
+# (``ckpt:<phase>`` spans from elastic/checkpoint.py) and "d2h" the
+# device→host snapshot instants — checkpoint stall is wire-class time the
+# step must hide exactly like a collective.
 _COMMS_KINDS = (
     "psum", "pmax", "pmin", "all_gather", "psum_scatter", "ppermute",
-    "all_to_all", "reduce_scatter", "allreduce", "comms",
+    "all_to_all", "reduce_scatter", "allreduce", "comms", "ckpt", "d2h",
 )
 
 

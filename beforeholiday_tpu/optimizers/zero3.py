@@ -585,8 +585,33 @@ def _shard_path(directory: str, rank: int) -> str:
     return os.path.join(directory, f"shard_{rank:05d}.npz")
 
 
+# rename seam: the kill-mid-save drill (tests/test_elastic.py) hooks this to
+# SIGKILL the writer between file landings and prove the previous checkpoint
+# generation still loads
+_rename = os.replace
+
+
+def _atomic_write(path: str, write_fn) -> None:
+    """Write via temp file + fsync + atomic rename: ``path`` either holds
+    the COMPLETE new contents or does not exist — never a torn file."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
+    _rename(tmp, path)
+
+
 def save_shard_files(directory, shard_states, manifest) -> None:
-    """Write ``manifest.json`` + one ``shard_{rank}.npz`` per rank."""
+    """Write one ``shard_{rank}.npz`` per rank, then ``manifest.json``.
+
+    Crash-safe by construction: every file lands through
+    :func:`_atomic_write` (temp file + fsync + atomic rename), and the
+    manifest is stamped LAST — so a writer killed mid-save leaves stray
+    ``*.tmp`` files and a manifest-less directory, never a loadable torn
+    checkpoint. ``load_shard_files`` refuses a manifest-less directory and
+    ``elastic.latest_generation`` falls back to the previous durable
+    generation; manifest presence IS durability."""
     if len(shard_states) != manifest["world"]:
         raise ValueError(
             f"got {len(shard_states)} shard states for manifest "
@@ -601,11 +626,15 @@ def save_shard_files(directory, shard_states, manifest) -> None:
                     f"shard {r} key {key!r} has shape {arr.shape}, manifest "
                     f"says ({manifest['shard_len']},)"
                 )
-        np.savez(_shard_path(directory, r), **{
-            k: np.asarray(v) for k, v in sd.items()
-        })
-    with open(os.path.join(directory, _MANIFEST_NAME), "w") as f:
-        json.dump(manifest, f, indent=1)
+        payload = {k: np.asarray(v) for k, v in sd.items()}
+        _atomic_write(
+            _shard_path(directory, r),
+            lambda f, p=payload: np.savez(f, **p),
+        )
+    _atomic_write(
+        os.path.join(directory, _MANIFEST_NAME),
+        lambda f: f.write(json.dumps(manifest, indent=1).encode("utf-8")),
+    )
 
 
 def load_shard_files(directory):

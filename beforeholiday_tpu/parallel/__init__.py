@@ -14,6 +14,7 @@ from beforeholiday_tpu.parallel.bucketing import (
 from beforeholiday_tpu.parallel.distributed import (
     DistributedDataParallel,
     Reducer,
+    check_replicated_consistency,
     reduce_gradients,
 )
 from beforeholiday_tpu.parallel.overlap import (
@@ -30,6 +31,7 @@ from beforeholiday_tpu.parallel.sync_batch_norm import (
     sync_batch_norm,
 )
 from beforeholiday_tpu.parallel.parallel_state import (
+    carve_data_mesh,
     initialize_model_parallel,
     destroy_model_parallel,
     model_parallel_is_initialized,
@@ -48,6 +50,8 @@ __all__ = [
     "DEFAULT_BUCKET_BYTES",
     "DistributedDataParallel",
     "Reducer",
+    "carve_data_mesh",
+    "check_replicated_consistency",
     "reduce_gradients",
     "reduction_hook",
     "hook_tree",

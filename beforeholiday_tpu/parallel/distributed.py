@@ -60,6 +60,36 @@ def _grad_fingerprint(grads: Any) -> jax.Array:
     return jnp.concatenate(parts)
 
 
+def check_replicated_consistency(
+    tree: Any,
+    axis_name: Any = DATA_AXIS,
+    *,
+    site: str = "ddp.consistency",
+) -> jax.Array:
+    """Traced bool: True when any rank's fingerprint of ``tree`` disagrees
+    across ``axis_name`` or holds a non-finite value.
+
+    For values that are replicated BY CONSTRUCTION — pre-reduce grads under
+    a replicated batch, ZeRO-3 gathered params (every rank all-gathered the
+    same shards), broadcast batches — a disagreement means silent LOCAL
+    corruption (an SEU, a bad HBM read) that the downstream collective
+    would launder into every rank. This is the tripwire the elastic trainer
+    treats as a resize/reload event, and the primitive behind
+    ``reduce_gradients(check_consistency=True)``.
+
+    Cost: one pmax+pmin of a tiny (2·n_leaves,) vector plus one pmax of the
+    combined flag. Never raises; every rank returns the same verdict. Must
+    run inside a binding context for ``axis_name``."""
+    fp = _grad_fingerprint(tree)
+    hi = comms.pmax(fp, axis_name, site=site)
+    lo = comms.pmin(fp, axis_name, site=site)
+    # the non-finite test is rank-LOCAL (pmax may drop a lone NaN under
+    # maxNum semantics), so the combined flag gets its own reduction —
+    # every rank must return the same verdict
+    local_bad = jnp.any(hi != lo) | jnp.any(~jnp.isfinite(fp))
+    return comms.pmax(local_bad.astype(jnp.int32), axis_name, site=site) > 0
+
+
 def reduce_gradients(
     grads: Any,
     *,
@@ -133,20 +163,8 @@ def reduce_gradients(
 
         mismatch = None
         if check_consistency:
-            fp = _grad_fingerprint(grads)
-            hi = comms.pmax(fp, axis_name, site="ddp.grad_fingerprint")
-            lo = comms.pmin(fp, axis_name, site="ddp.grad_fingerprint")
-            # the non-finite test is rank-LOCAL (pmax may drop a lone NaN under
-            # maxNum semantics), so the combined flag gets its own reduction —
-            # every rank must return the same verdict
-            local_bad = jnp.any(hi != lo) | jnp.any(~jnp.isfinite(fp))
-            mismatch = (
-                comms.pmax(
-                    local_bad.astype(jnp.int32),
-                    axis_name,
-                    site="ddp.grad_fingerprint",
-                )
-                > 0
+            mismatch = check_replicated_consistency(
+                grads, axis_name, site="ddp.grad_fingerprint"
             )
 
         def _pre(g):

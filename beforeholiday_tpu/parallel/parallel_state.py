@@ -432,3 +432,33 @@ def make_two_level_mesh(
         n_slices, slice_size
     )
     return Mesh(dev_array, HIERARCHICAL_AXES)
+
+
+# --- elastic resize helpers -----------------------------------------------------------
+
+
+def carve_data_mesh(
+    world: int,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = DATA_AXIS,
+) -> Mesh:
+    """Carve a fresh 1-D ``(axis_name,)`` mesh over the FIRST ``world``
+    entries of ``devices`` (default ``jax.devices()``) — the surviving-world
+    mesh an elastic resize builds after rank loss.
+
+    Rank order is the device-list order, matching what a flat ``(data,)``
+    mesh over the same prefix would produce, so state resharded with
+    ``zero3.reshard_state`` lands on the rank that owns the identical arena
+    slice. Like ``make_two_level_mesh`` this does NOT install global
+    parallel state — the elastic trainer owns its mesh explicitly and
+    rebuilds it per resize."""
+    if devices is None:
+        devices = jax.devices()
+    devs = np.asarray(devices, dtype=object).ravel()
+    if not 1 <= world <= devs.size:
+        raise ValueError(
+            f"cannot carve a world-{world} data mesh from {devs.size} "
+            "device(s); world must be in [1, len(devices)]"
+        )
+    return Mesh(devs[:world], (axis_name,))

@@ -12,17 +12,38 @@ same seed always poisons the same leaves).
   (the kernel-build failure the jnp degradation must absorb);
 * :func:`perturb_rank_grads` — perturb ONE rank's grads inside ``shard_map``
   (the silent divergence ``reduce_gradients(check_consistency=True)`` must
-  flag).
+  flag);
+* :func:`preempt_after`     — raise :class:`SimulatedPreemption` on the n-th
+  tick (the in-process preemption notice the elastic trainer must survive);
+* :func:`kill_rank`         — SIGKILL/SIGTERM a subprocess rank (the hard
+  host loss the preemption drills inject for real).
 """
 
 from __future__ import annotations
 
 import contextlib
 import random
-from typing import Any, Iterator, Optional
+import signal
+from typing import Any, Callable, Iterator, Optional
 
 import jax
 import jax.numpy as jnp
+
+
+class SimulatedPreemption(RuntimeError):
+    """In-process stand-in for a preemption notice / lost rank.
+
+    ``surviving_world`` optionally names the world size that remains after
+    the event (e.g. a host carrying 4 of 8 ranks died); ``None`` defers to
+    the elastic trainer's ``survivor_policy``. Raised by
+    :func:`preempt_after`; catchable anywhere a real preemption callback
+    would fire.
+    """
+
+    def __init__(self, message: str = "simulated preemption", *,
+                 surviving_world: Optional[int] = None):
+        super().__init__(message)
+        self.surviving_world = surviving_world
 
 
 def poison_grads(
@@ -113,3 +134,46 @@ def perturb_rank_grads(
         return jnp.where(idx == rank, bad, g)
 
     return jax.tree_util.tree_map(_corrupt, grads)
+
+
+def preempt_after(n_steps: int, *,
+                  surviving_world: Optional[int] = None
+                  ) -> Callable[[], None]:
+    """Deterministic in-process preemption: a ``tick()`` whose ``n_steps``-th
+    call raises :class:`SimulatedPreemption` (once — later calls pass, so a
+    trainer that survives the event keeps running).
+
+    Host-side by design: call it once per step OUTSIDE the traced function
+    (``ElasticTrainer.run(..., preemption=preempt_after(7))``), exactly
+    where a real preemption-notice callback would interrupt the loop.
+    ``surviving_world`` rides the exception for the trainer's resize.
+    """
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    calls = {"n": 0}
+
+    def tick() -> None:
+        calls["n"] += 1
+        if calls["n"] == n_steps:
+            raise SimulatedPreemption(
+                f"simulated preemption on tick {n_steps}",
+                surviving_world=surviving_world,
+            )
+
+    return tick
+
+
+def kill_rank(proc, *, sig: int = signal.SIGKILL,
+              timeout: float = 30.0) -> int:
+    """Deliver ``sig`` to a subprocess rank and reap it; returns the exit
+    code (negative signal number on POSIX).
+
+    ``SIGKILL`` (default) is the hard host loss — no cleanup runs, so an
+    in-flight checkpoint generation is torn and a resume must fall back to
+    the last durable one. ``SIGTERM`` instead exercises graceful-notice
+    paths like ``FlightRecorder.arm_preemption_dump``. ``proc`` is a
+    ``subprocess.Popen`` (the drills spawn each rank as its own process;
+    in-process simulated ranks use :func:`preempt_after`).
+    """
+    proc.send_signal(sig)
+    return proc.wait(timeout=timeout)

@@ -1054,6 +1054,34 @@ def bench_multislice():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_elastic():
+    """Elastic-training rungs on the virtual 8-CPU mesh subprocess. The
+    child runs the full preemption drill (a grandchild SIGKILLs itself
+    mid-run; resume at world=4 from the last durable generation must match
+    an independent uninterrupted reference bitwise — trajectory AND master
+    arena) and asserts the async checkpoint stall meter before printing:
+    ``ckpt_stall_hidden_fraction`` strictly positive and strictly above the
+    synchronous submit+wait baseline. Same env scrub as
+    ``bench_pp_overhead``."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.elastic_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"elastic_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_quantized():
     """O6 quantized-tier rungs on a CPU subprocess. The child pins the
     per-matmul quantized_matmul error inside its analytic bound, steps O5 and
@@ -1598,6 +1626,30 @@ def main():
             "printing"
         )
         pass2.update(inf.get("pass2") or {})
+
+    # --- elastic training: preemption drill + checkpoint stall meter ---
+    el = _stage(detail, bench_elastic)
+    if el:
+        for k in ("elastic_resume_bitwise", "ckpt_stall_hidden_fraction",
+                  "ckpt_timeline_overlap_fraction",
+                  "ckpt_sync_hidden_fraction", "ckpt_exposed_s",
+                  "ckpt_background_s", "ckpt_generations",
+                  "resumed_from_step", "killed_rc"):
+            detail[k] = el.get(k)
+        detail["elastic_bench"] = {
+            k: v for k, v in el.items() if k != "pass2"
+        }
+        detail["elastic_note"] = (
+            "8-CPU-mesh subprocess: the drill SIGKILLs a training child "
+            "mid-run and resumes at world=4 from the last durable async "
+            "generation — trajectory and master arena asserted bitwise "
+            "against an independent uninterrupted reference in the child "
+            "before anything prints; the stall meter's hidden fraction is "
+            "ckpt-ledger accounting (writer-thread work minus "
+            "training-thread blocked time), strictly positive and above "
+            "the synchronous baseline by child assert"
+        )
+        pass2.update(el.get("pass2") or {})
 
     # --- guard dispatch + comms + compile counters: what every rung above
     # actually dispatched/communicated/compiled (collected LAST so the

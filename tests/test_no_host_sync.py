@@ -42,13 +42,21 @@ _SKIP_DIRS = frozenset({"testing", "models"})
 # decode/decode_logits — serving cannot emit a token without reading it
 # back) and the batcher's scheduler drive points are the inference
 # subsystem's sanctioned boundary; everything below them (the step
-# functions, the paged cache ops) must stay sync-free
+# functions, the paged cache ops) must stay sync-free. The elastic
+# checkpoint manager's snapshot/serialize entry points (``submit`` initiates
+# the async D2H copy, ``wait`` drains, ``_write_generation`` joins the copy
+# on the writer thread) are the ONE place checkpointing may touch host
+# values; the trainer's run loop gets no sanction — it drains the step row
+# the same way the examples do
 _SANCTIONED_BY_FILE = {
     "monitor/export.py": frozenset({"drain", "flush", "_fetch"}),
     "monitor/trace.py": frozenset({"export"}),
     "monitor/flight.py": frozenset({"dump"}),
     "infer/engine.py": frozenset({"prefill", "decode", "decode_logits"}),
     "infer/batching.py": frozenset({"step", "static_batched_generate"}),
+    "elastic/checkpoint.py": frozenset(
+        {"submit", "wait", "_write_generation"}
+    ),
 }
 
 # file-scoped waivers for sync points that are part of a documented host-side
@@ -154,7 +162,7 @@ def test_monitor_package_is_scanned():
     assert "monitor" not in _SKIP_DIRS
     assert set(_SANCTIONED_BY_FILE) == {
         "monitor/export.py", "monitor/trace.py", "monitor/flight.py",
-        "infer/engine.py", "infer/batching.py",
+        "infer/engine.py", "infer/batching.py", "elastic/checkpoint.py",
     }
     assert _SANCTIONED_BY_FILE["monitor/export.py"] == {"drain", "flush", "_fetch"}
     assert _SANCTIONED_BY_FILE["monitor/trace.py"] == {"export"}
@@ -305,6 +313,29 @@ def test_multislice_surface_is_scanned():
         assert pathlib.Path(rel).parts[0] not in _SKIP_DIRS
         assert rel not in _SANCTIONED_BY_FILE
         assert not any(path == rel for path, _ in _WAIVED)
+
+
+def test_elastic_is_scanned():
+    """elastic/ promises that checkpointing's host side is confined to the
+    manager's snapshot/serialize entry points: ``submit`` (initiates the
+    non-blocking D2H copy), ``wait`` (drains the queue), and
+    ``_write_generation`` (joins the copy on the writer thread). The trainer's
+    loop drains its step row between steps like the examples do (bind the
+    fetched value to a name first — ``float(<subscript>)`` stays flagged) and
+    gets NO sanction, so a future readback inside its step path fails
+    loudly."""
+    elastic_files = sorted(
+        p.relative_to(_PKG_ROOT).as_posix()
+        for p in (_PKG_ROOT / "elastic").rglob("*.py")
+    )
+    assert "elastic/checkpoint.py" in elastic_files
+    assert "elastic/trainer.py" in elastic_files
+    assert "elastic" not in _SKIP_DIRS
+    assert _SANCTIONED_BY_FILE["elastic/checkpoint.py"] == {
+        "submit", "wait", "_write_generation",
+    }
+    assert "elastic/trainer.py" not in _SANCTIONED_BY_FILE
+    assert not any(path.startswith("elastic/") for path, _ in _WAIVED)
 
 
 def test_quantized_tier_is_scanned():
