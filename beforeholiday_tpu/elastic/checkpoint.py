@@ -45,6 +45,7 @@ exactly this set) — nothing here runs inside a traced step.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import queue
 import shutil
@@ -170,11 +171,38 @@ def generation_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"{_GEN_PREFIX}{step:08d}")
 
 
+def _generation_durable(path: str) -> bool:
+    """The two-level durability rule: the top-level manifest must exist
+    AND, when it declares a multi-host partition, every per-host manifest
+    must too. A manifest that exists but cannot be parsed counts as
+    non-durable (a torn rename never produces one — ``_atomic_write`` —
+    but a corrupted filesystem might, and restore must not trust it)."""
+    mpath = os.path.join(path, zero3._MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        return False
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return False
+    hosts = zero3.manifest_hosts(manifest)
+    if hosts <= 1:
+        return True
+    return all(
+        os.path.isfile(zero3.host_manifest_path(path, h))
+        for h in range(hosts)
+    )
+
+
 def list_generations(directory: str) -> List[Tuple[int, str, bool]]:
     """All ``gen_*`` entries as ``(step, path, durable)`` sorted by step.
     ``durable`` is manifest presence — ``save_shard_files`` stamps the
     manifest last, so a torn (killed mid-save) generation scans as
-    non-durable and is never offered for restore."""
+    non-durable and is never offered for restore. Multi-host generations
+    must be durable on ALL hosts: a top-level manifest whose declared
+    per-host manifests are not all present (one host's storage torn or
+    lost) scans as non-durable, and restore falls back to the previous
+    generation every host finished."""
     if not os.path.isdir(directory):
         return []
     out = []
@@ -189,8 +217,7 @@ def list_generations(directory: str) -> List[Tuple[int, str, bool]]:
         path = os.path.join(directory, name)
         if not os.path.isdir(path):
             continue
-        durable = os.path.isfile(os.path.join(path, zero3._MANIFEST_NAME))
-        out.append((step, path, durable))
+        out.append((step, path, _generation_durable(path)))
     out.sort(key=lambda t: t[0])
     return out
 
@@ -242,10 +269,16 @@ class CheckpointManager:
         (backpressure; booked to the ledger).
     keep: durable generations retained; older ones are pruned after each
         new generation lands.
+    hosts: simulated multi-host write partition — each of ``hosts`` hosts
+        writes only its contiguous rank subset plus a per-host manifest
+        (``save_shard_files``'s two-level durability). ``None`` keeps
+        whatever the manifest declares (default 1: single-writer,
+        PR-12-identical layout). Must divide the manifest's world.
     """
 
     def __init__(self, directory: str, manifest: Dict[str, Any], *,
-                 queue_depth: int = 2, keep: int = 2):
+                 queue_depth: int = 2, keep: int = 2,
+                 hosts: Optional[int] = None):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         if keep < 1:
@@ -266,9 +299,23 @@ class CheckpointManager:
         self.world = int(world)
         self.shard_len = int(shard_len)
         self._manifest = dict(manifest)
+        if hosts is not None:
+            if hosts < 1:
+                raise ValueError(f"hosts must be >= 1, got {hosts}")
+            if self.world % hosts:
+                raise ValueError(
+                    f"hosts={hosts} must divide world={self.world} "
+                    "(contiguous rank partition; pick "
+                    "zero3.effective_hosts(world, hosts) after a resize)"
+                )
+            self._manifest["hosts"] = int(hosts)
+            self._manifest.setdefault("manifest_version", 2)
+        self.hosts = zero3.manifest_hosts(self._manifest)
         self._state_keys = tuple(manifest["state_keys"])
         self._queue: "queue.Queue" = queue.Queue(maxsize=int(queue_depth))
-        self._error: Optional[BaseException] = None
+        # (exception, generation step) — surfaced on the NEXT submit/wait,
+        # naming the generation that failed to land
+        self._error: Optional[Tuple[BaseException, int]] = None
         self._last_durable: Optional[Tuple[int, str]] = None
         self._lock = threading.Lock()
         self._closed = False
@@ -353,12 +400,17 @@ class CheckpointManager:
 
     def _raise_pending(self) -> None:
         with self._lock:
-            err = self._error
+            pending = self._error
             self._error = None
-        if err is not None:
+        if pending is not None:
+            err, step = pending
+            gen = generation_dir(self.directory, step)
             raise RuntimeError(
-                "checkpoint writer thread failed; the training loop must "
-                "not keep running on the assumption its state is durable"
+                f"checkpoint writer thread failed writing generation "
+                f"{os.path.basename(gen)} (step {step}); that generation "
+                "is not durable — the training loop must not keep running "
+                "on the assumption its state is; the previous durable "
+                "generation is still restorable"
             ) from err
 
     def _book_d2h(self, leaves: Dict[str, Any]) -> None:
@@ -381,10 +433,12 @@ class CheckpointManager:
             try:
                 self._write_generation(*item)
             except BaseException as e:  # noqa: BLE001 — surfaced on submit/wait
-                logger.exception("checkpoint generation write failed")
+                logger.exception(
+                    "checkpoint generation write failed (step %d)", item[0]
+                )
                 with self._lock:
                     if self._error is None:
-                        self._error = e
+                        self._error = (e, item[0])
             finally:
                 self._queue.task_done()
 

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -60,6 +61,7 @@ else:
     _shard_map = functools.partial(_esm, check_rep=False)
 
 from beforeholiday_tpu.elastic import checkpoint as ckpt
+from beforeholiday_tpu.elastic.watchdog import RankHangError
 from beforeholiday_tpu.optimizers import zero3
 from beforeholiday_tpu.parallel.parallel_state import (
     DATA_AXIS,
@@ -109,13 +111,15 @@ def guard_state_specs(guard, axis_name: str = DATA_AXIS):
 
 @dataclasses.dataclass(frozen=True)
 class ResizeEvent:
-    """One elastic resize, as it happened."""
+    """One elastic resize (or graceful drain), as it happened."""
 
-    reason: str          # "preemption" | "tripwire" | "manual"
+    reason: str          # "preemption" | "tripwire" | "hang" | "grow" |
+                         # "manual" | "preemption_drain"
     at_step: int         # global step when the event fired
     old_world: int
     new_world: int
     resumed_from: int    # generation step the trainer reloaded
+    stall_s: float = 0.0  # wall time the loop spent on drain+reload+reshard
 
 
 class ElasticTrainer:
@@ -133,6 +137,23 @@ class ElasticTrainer:
     survivor_policy: world -> surviving world when an event does not name
         one (default halve).
     min_world: resizing below this raises instead of limping on.
+    hosts: simulated multi-host checkpoint partition — each host writes
+        only its rank subset + a per-host manifest; a resized world keeps
+        the largest compatible partition (``zero3.effective_hosts``).
+    notice: a :class:`~beforeholiday_tpu.elastic.signals.PreemptionNotice`
+        (installed by the caller) polled once per step; its raised
+        ``SimulatedPreemption`` takes the same resize/drain path as the
+        injected one.
+    watchdog: a :class:`~beforeholiday_tpu.elastic.watchdog.HangWatchdog`
+        — the loop heartbeats every rank after each committed step and
+        polls :meth:`~HangWatchdog.check`; a flagged hang resizes like a
+        tripwire. Heartbeat state rides the manifest ``extra``.
+    capacity_probe: ``() -> available device count``, polled at checkpoint
+        boundaries when ``grow_when_available`` is on; when capacity
+        allows a larger valid world the trainer resizes UP from the
+        generation it just submitted (no committed step is lost).
+    grow_when_available: enable grow-back (and permit resize targets
+        above the current world).
     """
 
     def __init__(
@@ -150,6 +171,11 @@ class ElasticTrainer:
         axis_name: str = DATA_AXIS,
         min_world: int = 1,
         survivor_policy: Optional[Callable[[int], int]] = None,
+        hosts: int = 1,
+        notice=None,
+        watchdog=None,
+        capacity_probe: Optional[Callable[[], int]] = None,
+        grow_when_available: bool = False,
     ):
         self.opt = opt
         self.layout = layout
@@ -162,6 +188,13 @@ class ElasticTrainer:
         self.axis_name = axis_name
         self.min_world = int(min_world)
         self.survivor_policy = survivor_policy or (lambda w: w // 2)
+        if hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {hosts}")
+        self.hosts = int(hosts)
+        self.notice = notice
+        self.watchdog = watchdog
+        self.capacity_probe = capacity_probe
+        self.grow_when_available = bool(grow_when_available)
         self._devices = np.asarray(
             jax.devices() if devices is None else devices
         ).ravel()
@@ -241,6 +274,15 @@ class ElasticTrainer:
                         self._state if self.guard.rollback_after else None
                     ),
                 )
+        if self.watchdog is not None:
+            hb = (manifest.get("extra") or {}).get("heartbeats")
+            if hb is not None and int(hb.get("world", -1)) == world:
+                # same topology: restore last-heard steps (clocks re-arm at
+                # now inside load_state_dict — a restore must never inherit
+                # a pre-crash silence window). A resharded world keeps the
+                # fresh ledger _install_world already armed; PR-12
+                # manifests carry no heartbeats key and default the same.
+                self.watchdog.load_state_dict(hb)
         self.global_step = int(manifest.get("step", step))
         return self.global_step
 
@@ -279,18 +321,51 @@ class ElasticTrainer:
             try:
                 if preemption is not None:
                     preemption()
+                if self.notice is not None:
+                    self.notice.tick()
+                if self.watchdog is not None:
+                    self.watchdog.check()
                 batch = batch_fn(self.global_step)
                 new_state, new_gstate, row = self._step_fn(
                     self._state, self._gstate, batch
                 )
                 fetched = {k: np.asarray(v) for k, v in row.items()}
             except SimulatedPreemption as e:
+                if e.drain:
+                    # graceful notice: this process is going away — make
+                    # the state durable and hand control back (exit 0),
+                    # instead of resizing a world that is being evicted
+                    t0 = time.perf_counter()
+                    self.checkpoint_now(wait=True)
+                    self.events.append(ResizeEvent(
+                        reason="preemption_drain", at_step=self.global_step,
+                        old_world=self.world, new_world=self.world,
+                        resumed_from=self.global_step,
+                        stall_s=time.perf_counter() - t0,
+                    ))
+                    logger.warning(
+                        "graceful drain at step %d (%s): generation durable, "
+                        "returning", self.global_step, e,
+                    )
+                    return self.history[appended:]
                 surviving = (
                     e.surviving_world
                     if e.surviving_world is not None
                     else self.survivor_policy(self.world)
                 )
                 self._resize(surviving, reason="preemption")
+                continue
+            except RankHangError as e:
+                # a silent rank is a lost rank that never said so: same
+                # recovery as the tripwire — the last committed state is
+                # durable, drop to the survivor world and replay
+                logger.warning(
+                    "hang watchdog fired at step %d (%s); resharding",
+                    self.global_step, e,
+                )
+                self._resize(
+                    self.survivor_policy(self.world), reason="hang"
+                )
                 continue
             mism = fetched.get("mismatch")
             if mism is not None and bool(np.any(mism)):
@@ -306,6 +381,10 @@ class ElasticTrainer:
                 continue
             self._state, self._gstate = new_state, new_gstate
             self.global_step += 1
+            if self.watchdog is not None:
+                # every simulated rank that stepped is alive by
+                # construction; injected hangs suppress individual beats
+                self.watchdog.beat_all(self.global_step)
             loss = fetched["loss"]
             self.history.append({
                 "step": self.global_step,
@@ -318,6 +397,7 @@ class ElasticTrainer:
                 and self.global_step % self.checkpoint_every == 0
             ):
                 self._submit_checkpoint()
+                self._maybe_grow()
         return self.history[appended:]
 
     def checkpoint_now(self, *, wait: bool = False) -> str:
@@ -331,20 +411,85 @@ class ElasticTrainer:
 
     # ------------------------------------------------------------- internals
     def _submit_checkpoint(self) -> str:
-        extra = None
+        extra: Dict[str, Any] = {}
         if self.guard is not None:
-            extra = {"guard": self.guard.state_dict(self._gstate)}
+            extra["guard"] = self.guard.state_dict(self._gstate)
+        if self.watchdog is not None:
+            extra["heartbeats"] = self.watchdog.state_dict()
         return self._manager.submit(
-            self.global_step, self._state, extra=extra
+            self.global_step, self._state, extra=extra or None
         )
 
+    def _maybe_grow(self) -> None:
+        """Checkpoint-boundary grow-back: when the capacity probe reports
+        room for a larger valid world, resize UP from the generation just
+        submitted — ``global_step`` equals its step, so the restore loses
+        no committed work and the continued trajectory is bitwise the
+        new-world trajectory from that checkpoint."""
+        if not (self.grow_when_available and self.capacity_probe):
+            return
+        cap = int(self.capacity_probe())
+        target = self._grow_target(cap)
+        if target is None:
+            return
+        logger.warning(
+            "capacity probe reports %d devices available at step %d; "
+            "growing %d -> %d", cap, self.global_step, self.world, target,
+        )
+        self._resize(target, reason="grow")
+
+    def _grow_target(self, capacity: int) -> Optional[int]:
+        """Largest world > the current one that divides the device count
+        and fits ``capacity`` (None when capacity allows no growth)."""
+        ndev = int(self._devices.size)
+        for w in range(min(capacity, ndev), self.world, -1):
+            if ndev % w == 0:
+                return w
+        return None
+
+    def _validate_resize_target(self, new_world: int, *,
+                                reason: str) -> None:
+        """A survivor policy (or event payload) naming a bad world must
+        fail loudly, not limp into a nonsense mesh carve or a silent
+        no-op."""
+        ndev = int(self._devices.size)
+        if new_world < 1:
+            raise ValueError(
+                f"resize target must be >= 1, got {new_world} "
+                f"(reason={reason!r})"
+            )
+        if ndev % new_world:
+            raise ValueError(
+                f"resize target {new_world} does not divide the device "
+                f"count {ndev} — the ZeRO-3 arena reshards only onto "
+                f"worlds that tile the slice (reason={reason!r})"
+            )
+        if new_world == self.world:
+            raise ValueError(
+                f"resize target {new_world} equals the current world "
+                f"(reason={reason!r}) — a resize must change the world; "
+                "grow-back reclaims returned capacity at checkpoint "
+                "boundaries instead of re-resizing in place"
+            )
+        if new_world > self.world and not (
+            self.grow_when_available or reason == "manual"
+        ):
+            raise ValueError(
+                f"resize target {new_world} grows past the current world "
+                f"{self.world} but grow_when_available is off "
+                f"(reason={reason!r})"
+            )
+
     def _resize(self, new_world: int, *, reason: str) -> None:
+        new_world = int(new_world)
+        self._validate_resize_target(new_world, reason=reason)
         if new_world < max(1, self.min_world):
             raise RuntimeError(
                 f"resize to world={new_world} is below min_world="
                 f"{self.min_world}; cannot continue"
             )
         old_world, at = self.world, self.global_step
+        t0 = time.perf_counter()
         if self._manager is not None:
             # drain in-flight generations so the newest submitted one is
             # durable before we go looking for it
@@ -353,6 +498,7 @@ class ElasticTrainer:
         self.events.append(ResizeEvent(
             reason=reason, at_step=at, old_world=old_world,
             new_world=new_world, resumed_from=resumed,
+            stall_s=time.perf_counter() - t0,
         ))
         logger.warning(
             "elastic resize (%s) at step %d: world %d -> %d, resumed from "
@@ -367,8 +513,15 @@ class ElasticTrainer:
             self.world, devices=self._devices, axis_name=self.axis_name
         )
         self._step_fn = self.make_step(self.mesh, self.world)
-        manifest = zero3.shard_manifest(self.layout, self.world)
+        manifest = zero3.shard_manifest(
+            self.layout, self.world,
+            hosts=zero3.effective_hosts(self.world, self.hosts),
+        )
         self._manager = ckpt.CheckpointManager(
             self.directory, manifest,
             queue_depth=self.queue_depth, keep=self.keep,
         )
+        if self.watchdog is not None:
+            # fresh beat clocks for the new world — a resize must not
+            # inherit the silence window that triggered it
+            self.watchdog.reset(self.world)

@@ -70,12 +70,24 @@ _HEALTH_KEYS = (
 )
 
 
+# liveness keys an elastic metrics row may carry alongside guard health:
+# the world that committed the step and the tripwire's mismatch count —
+# rendered (not stored) so a post-mortem flight dump from a preemption or
+# a watchdog-flagged hang shows the topology the job died in
+_LIVENESS_KEYS = ("world", "mismatch")
+
+
 def health_summary(health: Dict[str, Any]) -> Dict[str, Any]:
     """Human-readable rendering of an ALREADY-FETCHED health/metrics row
     (a ``MetricsLogger`` row, a ``state_dict()["health"]`` — host numbers,
     never traced values): the health keys present, plus the skip-reason code
-    decoded to its name. The flight recorder stamps this onto its dumps."""
-    out = {k: health[k] for k in _HEALTH_KEYS if k in health}
+    decoded to its name, plus the elastic liveness keys (``world``,
+    ``mismatch``) when the row carries them. The flight recorder stamps
+    this onto its dumps."""
+    out = {
+        k: health[k]
+        for k in (*_HEALTH_KEYS, *_LIVENESS_KEYS) if k in health
+    }
     reason = health.get("last_skip_reason")
     if reason is not None:
         out["last_skip_reason_name"] = SKIP_REASON_NAMES.get(

@@ -24,7 +24,13 @@ Two triggers turn the ring into an artifact:
   SIGTERM handler that dumps the ring plus the last durable checkpoint
   generation (:meth:`note_checkpoint`, stamped by the elastic
   ``CheckpointManager``) and then re-delivers the signal, so the process
-  still dies a signal death after the black box is on disk.
+  still dies a signal death after the black box is on disk. When a
+  GRACEFUL consumer is registered for the signal
+  (:func:`register_preemption_consumer` — the elastic
+  ``PreemptionNotice`` registers itself), the handler dumps FIRST and then
+  hands the notice to the consumer instead of re-delivering: the elastic
+  run loop drains (checkpoint made durable, clean exit) with the black box
+  already on disk, which is the production preemption path.
 
 Usage::
 
@@ -54,7 +60,49 @@ logger = get_logger(__name__)
 __all__ = [
     "FlightRecorder",
     "active_flight_recorder",
+    "preemption_consumer",
+    "register_preemption_consumer",
+    "unregister_preemption_consumer",
 ]
+
+
+# graceful-drain consumers by signal number: when the preemption-dump
+# handler fires and a consumer is registered for that signal, the dump is
+# written and the notice is HANDED OFF (consumer called with the signum)
+# instead of re-delivered — the consumer (the elastic PreemptionNotice)
+# owns the shutdown from there
+_PREEMPTION_CONSUMERS: Dict[int, Any] = {}
+_CONSUMER_LOCK = threading.Lock()
+
+
+def register_preemption_consumer(signum: int, callback) -> None:
+    """Register ``callback(signum)`` as the graceful-drain consumer for
+    ``signum``. While registered, an armed preemption dump for that signal
+    dumps the black box and then NOTIFIES the consumer instead of
+    re-delivering the signal — a trainer that can drain cleanly gets to.
+    One consumer per signal; re-registering replaces."""
+    with _CONSUMER_LOCK:
+        _PREEMPTION_CONSUMERS[int(signum)] = callback
+
+
+def unregister_preemption_consumer(signum: int, callback=None) -> None:
+    """Remove the consumer for ``signum`` (no-op when none registered;
+    with ``callback`` given, only removes if it is the registered one —
+    an uninstall cannot evict a newer notice)."""
+    with _CONSUMER_LOCK:
+        cur = _PREEMPTION_CONSUMERS.get(int(signum))
+        if cur is None:
+            return
+        if callback is not None and cur is not callback:
+            return
+        del _PREEMPTION_CONSUMERS[int(signum)]
+
+
+def preemption_consumer(signum: int):
+    """The registered graceful-drain consumer for ``signum`` (None when
+    the signal should fall through to re-delivery)."""
+    with _CONSUMER_LOCK:
+        return _PREEMPTION_CONSUMERS.get(int(signum))
 
 
 def _counter_totals() -> Dict[str, Any]:
@@ -255,8 +303,12 @@ class FlightRecorder:
         checkpoint generation from :meth:`note_checkpoint`) and then
         RE-DELIVERS the signal under the previous disposition — the process
         still dies a signal death (exit 143 for SIGTERM), so supervisors
-        see the truthful status instead of a masked clean exit. Main thread
-        only (``signal.signal``'s contract); idempotent;
+        see the truthful status instead of a masked clean exit. When a
+        graceful consumer is registered for the signal
+        (:func:`register_preemption_consumer`), the handler instead hands
+        the notice off after the dump — dump first, then graceful drain —
+        and stays armed for a repeat notice. Main thread only
+        (``signal.signal``'s contract); idempotent;
         :meth:`disarm_preemption_dump` restores."""
         if self._sig_num is not None:
             return self
@@ -272,6 +324,16 @@ class FlightRecorder:
                 logger.exception(
                     "flight-recorder dump failed in preemption handler"
                 )
+            consumer = preemption_consumer(s)
+            if consumer is not None:
+                try:
+                    consumer(s)
+                except Exception:  # noqa: BLE001 — fall through to death
+                    logger.exception(
+                        "graceful preemption consumer failed; re-delivering"
+                    )
+                else:
+                    return
             prev = self._sig_prev
             self._sig_num = None
             self._sig_prev = None

@@ -90,6 +90,10 @@ __all__ = [
     "save_shard_files",
     "load_shard_files",
     "reshard_state",
+    "manifest_hosts",
+    "host_rank_range",
+    "host_manifest_path",
+    "effective_hosts",
 ]
 
 _MANIFEST_NAME = "manifest.json"
@@ -549,13 +553,29 @@ def shard_manifest(
     world: int,
     *,
     state_keys: Sequence[str] = _STATE_KEYS,
+    hosts: int = 1,
 ) -> Dict[str, Any]:
     """Layout manifest persisted next to the shard files: everything needed
-    to validate and reshard the flat arena at a different world size."""
+    to validate and reshard the flat arena at a different world size.
+
+    ``manifest_version`` 2 adds the multi-host partition (``hosts``): ranks
+    are split contiguously across ``hosts`` simulated hosts, each of which
+    writes only its own shard subset plus a per-host manifest. Version-1
+    manifests (no ``hosts``/``manifest_version`` keys) load with
+    ``hosts=1`` defaults — the single-host layout is byte-identical to
+    PR 12's."""
     spec = layout.spec
     shard = _shard_len(spec.padded_total, world)
+    if hosts < 1:
+        raise ValueError(f"hosts must be >= 1, got {hosts}")
+    if world % hosts:
+        raise ValueError(
+            f"hosts={hosts} must divide world={world} (contiguous rank "
+            "partition)"
+        )
     return {
         "format": _MANIFEST_FORMAT,
+        "manifest_version": 2,
         "arena_len": spec.padded_total,
         "total": spec.total,
         "world": world,
@@ -563,7 +583,45 @@ def shard_manifest(
         "pad": shard * world - spec.padded_total,
         "tile": TILE,
         "state_keys": list(state_keys),
+        "hosts": hosts,
     }
+
+
+def manifest_hosts(manifest: Dict[str, Any]) -> int:
+    """Host count declared by a manifest; version-1 manifests (PR 12) carry
+    no ``hosts`` key and default to 1."""
+    return int(manifest.get("hosts", 1))
+
+
+def host_rank_range(world: int, hosts: int, host: int) -> range:
+    """Contiguous rank subset owned by ``host``: with ``world=8, hosts=2``,
+    host 0 writes ranks 0..3 and host 1 writes ranks 4..7 (mirrors how a
+    real multi-host slice pins ranks to hosts)."""
+    if not 0 <= host < hosts:
+        raise ValueError(f"host {host} out of range for hosts={hosts}")
+    if world % hosts:
+        raise ValueError(f"hosts={hosts} must divide world={world}")
+    per = world // hosts
+    return range(host * per, (host + 1) * per)
+
+
+def effective_hosts(world: int, hosts: int) -> int:
+    """Largest host count ≤ ``hosts`` that divides ``world`` — the partition
+    a resized world keeps writing with (a shrink 8→4 under ``hosts=2``
+    stays 2-host; a shrink to world=1 degrades to 1 host, never fails)."""
+    if hosts < 1:
+        raise ValueError(f"hosts must be >= 1, got {hosts}")
+    for h in range(min(hosts, world), 0, -1):
+        if world % h == 0:
+            return h
+    return 1  # pragma: no cover — h=1 always divides
+
+
+def host_manifest_path(directory: str, host: int) -> str:
+    """Per-host durability stamp: ``host_<h>.manifest.json``. Presence means
+    this host's shard subset landed completely (each host stamps AFTER its
+    shards, mirroring the top-level manifest-last rule)."""
+    return os.path.join(directory, f"host_{host:03d}.manifest.json")
 
 
 def shards_from_stacked(stacked, world: int) -> List[Dict[str, np.ndarray]]:
@@ -602,6 +660,21 @@ def _atomic_write(path: str, write_fn) -> None:
     _rename(tmp, path)
 
 
+def _save_rank_shard(directory, rank, sd, manifest) -> None:
+    for key in manifest["state_keys"]:
+        arr = np.asarray(sd[key])
+        if arr.shape != (manifest["shard_len"],):
+            raise ValueError(
+                f"shard {rank} key {key!r} has shape {arr.shape}, manifest "
+                f"says ({manifest['shard_len']},)"
+            )
+    payload = {k: np.asarray(v) for k, v in sd.items()}
+    _atomic_write(
+        _shard_path(directory, rank),
+        lambda f, p=payload: np.savez(f, **p),
+    )
+
+
 def save_shard_files(directory, shard_states, manifest) -> None:
     """Write one ``shard_{rank}.npz`` per rank, then ``manifest.json``.
 
@@ -611,26 +684,47 @@ def save_shard_files(directory, shard_states, manifest) -> None:
     ``*.tmp`` files and a manifest-less directory, never a loadable torn
     checkpoint. ``load_shard_files`` refuses a manifest-less directory and
     ``elastic.latest_generation`` falls back to the previous durable
-    generation; manifest presence IS durability."""
+    generation; manifest presence IS durability.
+
+    With ``manifest["hosts"] > 1`` the write is partitioned like a real
+    multi-host job: each simulated host writes ONLY its contiguous rank
+    subset (:func:`host_rank_range`) and then stamps its own
+    ``host_<h>.manifest.json``; the top-level manifest still lands last,
+    after every host. Durability becomes two-level — a generation is
+    restorable only when the top manifest AND every declared host manifest
+    are present, so losing any single host's stamp (torn host) demotes the
+    whole generation and restore falls back to the last generation durable
+    on ALL hosts. ``hosts=1`` writes no host manifests: the on-disk layout
+    is exactly the version-1 single-writer one."""
     if len(shard_states) != manifest["world"]:
         raise ValueError(
             f"got {len(shard_states)} shard states for manifest "
             f"world={manifest['world']}"
         )
+    hosts = manifest_hosts(manifest)
     os.makedirs(directory, exist_ok=True)
-    for r, sd in enumerate(shard_states):
-        for key in manifest["state_keys"]:
-            arr = np.asarray(sd[key])
-            if arr.shape != (manifest["shard_len"],):
-                raise ValueError(
-                    f"shard {r} key {key!r} has shape {arr.shape}, manifest "
-                    f"says ({manifest['shard_len']},)"
-                )
-        payload = {k: np.asarray(v) for k, v in sd.items()}
-        _atomic_write(
-            _shard_path(directory, r),
-            lambda f, p=payload: np.savez(f, **p),
-        )
+    if hosts == 1:
+        for r, sd in enumerate(shard_states):
+            _save_rank_shard(directory, r, sd, manifest)
+    else:
+        for host in range(hosts):
+            ranks = host_rank_range(manifest["world"], hosts, host)
+            for r in ranks:
+                _save_rank_shard(directory, r, shard_states[r], manifest)
+            host_manifest = {
+                "format": _MANIFEST_FORMAT,
+                "manifest_version": manifest.get("manifest_version", 2),
+                "host": host,
+                "hosts": hosts,
+                "world": manifest["world"],
+                "ranks": list(ranks),
+            }
+            _atomic_write(
+                host_manifest_path(directory, host),
+                lambda f, m=host_manifest: f.write(
+                    json.dumps(m, indent=1).encode("utf-8")
+                ),
+            )
     _atomic_write(
         os.path.join(directory, _MANIFEST_NAME),
         lambda f: f.write(json.dumps(manifest, indent=1).encode("utf-8")),
@@ -640,7 +734,10 @@ def save_shard_files(directory, shard_states, manifest) -> None:
 def load_shard_files(directory):
     """Read back ``(manifest, [per-rank shard dicts])``, validating shard
     count, keys, and lengths — a missing or truncated shard file fails
-    loudly instead of resharding garbage."""
+    loudly instead of resharding garbage. Multi-host generations
+    (``hosts > 1``) must additionally hold every declared host manifest:
+    a torn host raises here and demotes the generation for
+    ``elastic.latest_generation``'s fallback scan."""
     mpath = os.path.join(directory, _MANIFEST_NAME)
     if not os.path.exists(mpath):
         raise FileNotFoundError(
@@ -654,6 +751,19 @@ def load_shard_files(directory):
             f"unknown manifest format {manifest.get('format')!r} "
             f"(want {_MANIFEST_FORMAT!r})"
         )
+    hosts = manifest_hosts(manifest)
+    if hosts > 1:
+        missing = [
+            h for h in range(hosts)
+            if not os.path.exists(host_manifest_path(directory, h))
+        ]
+        if missing:
+            raise FileNotFoundError(
+                f"generation {directory!r} is torn: top-level manifest "
+                f"declares hosts={hosts} but host manifest(s) "
+                f"{missing} are missing — this generation is not durable "
+                "on all hosts; restore from the previous fully-durable one"
+            )
     shards = []
     for r in range(manifest["world"]):
         p = _shard_path(directory, r)

@@ -162,33 +162,85 @@ def _require_mesh():
 
 # --------------------------------------------------------------- drill child
 def _train_role(args) -> None:
-    """The doomed rank: train at world=8 with async checkpoints, then
-    SIGKILL the whole process right after committing ``--kill-at`` steps —
-    whatever generation is in flight stays torn on disk."""
+    """The drill child. Three shapes, picked by flags:
+
+    * ``--kill-at N`` (default drill): train with async checkpoints, then
+      SIGKILL the whole process right after committing N steps — whatever
+      generation is in flight stays torn on disk.
+    * ``--term-at N [--arm-notice --dump PATH]``: self-deliver a REAL
+      SIGTERM after committing N steps with the flight recorder's
+      preemption dump armed and a ``PreemptionNotice`` installed — the
+      handler dumps the black box, hands off to the notice (no signal
+      re-delivery), the run loop drains, and the child exits 0 printing a
+      JSON line (``chaos_bench``'s graceful-drain drill).
+    * ``--resume``: restore from the last durable generation in ``--dir``
+      at ``--world`` ranks instead of ``init`` (the post-fault child).
+    """
     _require_mesh()
-    from beforeholiday_tpu.elastic import ElasticTrainer
+    import contextlib
+
+    from beforeholiday_tpu.elastic import ElasticTrainer, PreemptionNotice
+    from beforeholiday_tpu.monitor.flight import FlightRecorder
 
     dim, layers, rows = _geometry(args.quick)
     params, layout, opt, make_step = _engine(dim, layers)
     batch = _batch_fn(rows, dim)
+    world = args.world or WORLD
+    notice = None
+    if args.arm_notice:
+        notice = PreemptionNotice((signal.SIGTERM,)).install()
     trainer = ElasticTrainer(
         opt, layout, make_step, directory=args.dir,
         checkpoint_every=args.ckpt_every, queue_depth=2, keep=2,
+        hosts=args.hosts, notice=notice,
     )
-    trainer.init(params, world=WORLD)
-    while trainer.global_step < args.total:
-        trainer.run(1, batch)
-        if trainer.global_step == args.kill_at:
-            os.kill(os.getpid(), signal.SIGKILL)
-    raise RuntimeError(
-        f"train child survived to step {trainer.global_step} without being "
-        f"killed (kill_at={args.kill_at})"
-    )
+    rec = FlightRecorder(path=args.dump) if args.dump else None
+    drained = False
+    with rec if rec is not None else contextlib.nullcontext():
+        if rec is not None:
+            # armed AFTER the notice installed: the recorder's handler owns
+            # the signal, dumps first, then finds the notice registered as
+            # the graceful consumer — drain instead of re-delivery
+            rec.arm_preemption_dump(signal.SIGTERM)
+        if args.resume:
+            trainer.restore(world=world)
+        else:
+            trainer.init(params, world=world)
+        while trainer.global_step < args.total:
+            trainer.run(1, batch)
+            if trainer.events and trainer.events[-1].reason == (
+                "preemption_drain"
+            ):
+                # leave the recorder context BEFORE exiting: a sys.exit
+                # inside it would dump again (exception:SystemExit) over
+                # the preemption dump we are about to report
+                drained = True
+                break
+            if args.kill_at and trainer.global_step == args.kill_at:
+                os.kill(os.getpid(), signal.SIGKILL)
+            if args.term_at and trainer.global_step == args.term_at:
+                os.kill(os.getpid(), signal.SIGTERM)
+        if args.kill_at and not drained:
+            raise RuntimeError(
+                f"train child survived to step {trainer.global_step} "
+                f"without being killed (kill_at={args.kill_at})"
+            )
+    trainer.close()
+    if drained:
+        print(json.dumps({
+            "drained_at": trainer.global_step,
+            "world": trainer.world,
+            "dumps": list(rec.dumps) if rec is not None else [],
+        }))
+        sys.exit(0)
+    print(json.dumps({
+        "finished_at": trainer.global_step, "world": trainer.world,
+    }))
 
 
-def _spawn_killed_child(ckpt_dir: str, *, quick: bool, total: int,
-                        kill_at: int, ckpt_every: int) -> int:
-    """Run the drill child to its SIGKILL; returns the (negative) rc."""
+def _child_env() -> dict:
+    """Scrubbed env for a drill child: CPU platform, 8 virtual devices,
+    repo root importable."""
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     env = {
@@ -200,15 +252,34 @@ def _spawn_killed_child(ckpt_dir: str, *, quick: bool, total: int,
         f"--xla_force_host_platform_device_count={WORLD}"
     )
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn_train_child(ckpt_dir: str, *, quick: bool,
+                       extra_args: list = (), timeout: float = 300.0):
+    """Run a ``--role train`` child with ``extra_args`` appended; returns
+    the ``CompletedProcess`` (callers assert on rc/stdout — ``chaos_bench``
+    reuses this for its SIGTERM/SIGKILL legs)."""
     cmd = [
         sys.executable, "-m", "beforeholiday_tpu.testing.elastic_bench",
-        "--role", "train", "--dir", ckpt_dir, "--total", str(total),
-        "--kill-at", str(kill_at), "--ckpt-every", str(ckpt_every),
-    ]
+        "--role", "train", "--dir", ckpt_dir,
+    ] + list(extra_args)
     if quick:
         cmd.append("--quick")
-    proc = subprocess.run(
-        cmd, capture_output=True, text=True, timeout=300, env=env,
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+        env=_child_env(),
+    )
+
+
+def _spawn_killed_child(ckpt_dir: str, *, quick: bool, total: int,
+                        kill_at: int, ckpt_every: int) -> int:
+    """Run the drill child to its SIGKILL; returns the (negative) rc."""
+    proc = _spawn_train_child(
+        ckpt_dir, quick=quick, extra_args=[
+            "--total", str(total), "--kill-at", str(kill_at),
+            "--ckpt-every", str(ckpt_every),
+        ],
     )
     if proc.returncode != -signal.SIGKILL:
         raise AssertionError(
@@ -443,8 +514,14 @@ def _cli():
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--dir", default=None)
     ap.add_argument("--total", type=int, default=16)
-    ap.add_argument("--kill-at", dest="kill_at", type=int, default=11)
+    ap.add_argument("--kill-at", dest="kill_at", type=int, default=0)
+    ap.add_argument("--term-at", dest="term_at", type=int, default=0)
     ap.add_argument("--ckpt-every", dest="ckpt_every", type=int, default=2)
+    ap.add_argument("--world", type=int, default=0)
+    ap.add_argument("--hosts", type=int, default=1)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--arm-notice", dest="arm_notice", action="store_true")
+    ap.add_argument("--dump", default=None)
     args = ap.parse_args()
     if args.role == "train":
         if args.dir is None:

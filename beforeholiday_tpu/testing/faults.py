@@ -16,12 +16,20 @@ same seed always poisons the same leaves).
 * :func:`preempt_after`     — raise :class:`SimulatedPreemption` on the n-th
   tick (the in-process preemption notice the elastic trainer must survive);
 * :func:`kill_rank`         — SIGKILL/SIGTERM a subprocess rank (the hard
-  host loss the preemption drills inject for real).
+  host loss the preemption drills inject for real);
+* :func:`hang_rank`         — silence ONE rank's heartbeats on a
+  :class:`~beforeholiday_tpu.elastic.watchdog.HangWatchdog` (the rank that
+  hangs rather than dies — no exception, no exit, just silence);
+* :func:`tear_host_generation` — remove one host's manifest from a durable
+  multi-host checkpoint generation (the single-host storage loss a restore
+  must tolerate by falling back to the last generation durable on ALL
+  hosts).
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 import random
 import signal
 from typing import Any, Callable, Iterator, Optional
@@ -35,15 +43,21 @@ class SimulatedPreemption(RuntimeError):
 
     ``surviving_world`` optionally names the world size that remains after
     the event (e.g. a host carrying 4 of 8 ranks died); ``None`` defers to
-    the elastic trainer's ``survivor_policy``. Raised by
-    :func:`preempt_after`; catchable anywhere a real preemption callback
-    would fire.
+    the elastic trainer's ``survivor_policy``. ``drain=True`` marks a
+    GRACEFUL notice (the shape of a real SIGTERM from the scheduler: this
+    process itself is going away) — the elastic trainer responds by making
+    its state durable and returning cleanly instead of resizing in place.
+    Raised by :func:`preempt_after` and by
+    :meth:`~beforeholiday_tpu.elastic.signals.PreemptionNotice.tick`;
+    catchable anywhere a real preemption callback would fire.
     """
 
     def __init__(self, message: str = "simulated preemption", *,
-                 surviving_world: Optional[int] = None):
+                 surviving_world: Optional[int] = None,
+                 drain: bool = False):
         super().__init__(message)
         self.surviving_world = surviving_world
+        self.drain = bool(drain)
 
 
 def poison_grads(
@@ -177,3 +191,48 @@ def kill_rank(proc, *, sig: int = signal.SIGKILL,
     """
     proc.send_signal(sig)
     return proc.wait(timeout=timeout)
+
+
+def hang_rank(watchdog, rank: int, *, after_step: int = 0) -> Callable:
+    """Silence ``rank``'s heartbeats on ``watchdog`` once the global step
+    reaches ``after_step`` — the rank that HANGS rather than dies.
+
+    Unlike :func:`kill_rank` nothing exits and nothing raises: the rank
+    simply stops reporting while the rest of the job keeps stepping, which
+    is exactly the failure a liveness monitor (not an exception handler)
+    must catch. Installs a suppressor on the watchdog's heartbeat ledger
+    (``HangWatchdog.beat`` consults it) and returns it, so a test can
+    ``watchdog.remove_suppressor(...)`` to "un-hang" the rank.
+    """
+    if not 0 <= rank < watchdog.world:
+        raise ValueError(
+            f"rank {rank} out of range for watchdog world {watchdog.world}"
+        )
+    if after_step < 0:
+        raise ValueError(f"after_step must be >= 0, got {after_step}")
+
+    def suppress(r: int, step: int) -> bool:
+        return r == rank and step >= after_step
+
+    watchdog.add_suppressor(suppress)
+    return suppress
+
+
+def tear_host_generation(gen_path: str, host: int) -> str:
+    """Tear ONE simulated host's slice out of a durable multi-host
+    checkpoint generation: remove its per-host manifest (host-manifest
+    presence is that host's durability stamp, mirroring the top-level
+    rule), leaving the generation durable on every OTHER host but not on
+    ALL hosts — ``elastic.latest_generation`` must now fall back to the
+    previous fully-durable generation. Returns the removed path."""
+    from beforeholiday_tpu.optimizers import zero3
+
+    path = zero3.host_manifest_path(gen_path, host)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no host manifest for host {host} under {gen_path!r} — either "
+            "the generation is single-host (hosts=1 writes none) or it is "
+            "already torn"
+        )
+    os.remove(path)
+    return path

@@ -1082,6 +1082,33 @@ def bench_elastic():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_chaos():
+    """Chaos soak on the virtual 8-CPU mesh subprocess. The child runs six
+    seeded multi-fault schedules (SIGKILL'd and SIGTERM-drained training
+    subprocesses, injected shrinks, real SIGUSR1 preemption notices,
+    torn-host generations, watchdog-flagged hung ranks, capacity grow-back)
+    plus the dedicated 4->8 grow-back drill; EVERY schedule is asserted
+    bitwise against a fault-free lineage-replay reference before the child
+    prints. Same env scrub as ``bench_elastic``."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.chaos_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"chaos_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_quantized():
     """O6 quantized-tier rungs on a CPU subprocess. The child pins the
     per-matmul quantized_matmul error inside its analytic bound, steps O5 and
@@ -1650,6 +1677,28 @@ def main():
             "the synchronous baseline by child assert"
         )
         pass2.update(el.get("pass2") or {})
+
+    # --- chaos soak: randomized multi-fault schedules, all bitwise ---
+    ch = _stage(detail, bench_chaos)
+    if ch:
+        for k in ("chaos_schedules_survived", "chaos_schedules_total",
+                  "chaos_total_events", "chaos_sigkill_rc",
+                  "chaos_sigterm_drain_rc", "chaos_sigterm_dump_written",
+                  "growback_resume_bitwise", "growback_stall_s",
+                  "growback_stall_mean_s"):
+            detail[k] = ch.get(k)
+        detail["chaos_bench"] = {
+            k: v for k, v in ch.items() if k != "pass2"
+        }
+        detail["chaos_note"] = (
+            "8-CPU-mesh subprocess: six seeded fault schedules composing "
+            "{SIGKILL, SIGTERM drain, shrink, grow-back, torn host "
+            "generation, hung rank}, each bitwise vs a fault-free "
+            "lineage-replay reference, plus the dedicated 4->8 grow-back "
+            "drill; survived counts and the grow drill verdict are gated, "
+            "the grow-back stall meter is wall-clock and reported ungated"
+        )
+        pass2.update(ch.get("pass2") or {})
 
     # --- guard dispatch + comms + compile counters: what every rung above
     # actually dispatched/communicated/compiled (collected LAST so the

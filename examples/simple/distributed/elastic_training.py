@@ -66,6 +66,10 @@ def parse_args(argv=None):
                    help="world size that survives the preemption")
     p.add_argument("--checkpoint-every", type=int, default=2,
                    help="submit an async generation every N committed steps")
+    p.add_argument("--grow-back-at-step", type=int, default=0,
+                   help="committed step at which the capacity probe reports "
+                        "the full slice available again; the trainer grows "
+                        "back at the next checkpoint boundary (0 = never)")
     return p.parse_args(argv)
 
 
@@ -133,11 +137,26 @@ def main(argv=None):
         if args.preempt_at_step else None
     )
 
+    # the capacity probe models the slice scheduler: after the preemption
+    # only --resume-world devices exist, until --grow-back-at-step when the
+    # full slice returns; the trainer reclaims it at a checkpoint boundary
+    box = {}
+    def capacity_probe():
+        tr = box.get("tr")
+        if tr is not None and tr.global_step >= args.grow_back_at_step:
+            return world
+        return args.resume_world
+
     with tempfile.TemporaryDirectory() as root:
         with ElasticTrainer(
             opt, layout, make_step, directory=f"{root}/live",
             checkpoint_every=args.checkpoint_every,
+            grow_when_available=bool(args.grow_back_at_step),
+            capacity_probe=(
+                capacity_probe if args.grow_back_at_step else None
+            ),
         ) as tr:
+            box["tr"] = tr
             tr.init(params, world=world)
             tr.run(args.steps, batch_fn, preemption=preemption)
             for ev in tr.events:
@@ -148,10 +167,25 @@ def main(argv=None):
                 print(f"  step {row['step']:3d}  world {row['world']}  "
                       f"loss {row['loss']:+.6f}")
             survived = np.asarray(tr.state["master"])
-            tail = [r for r in tr.history if r["world"] == tr.world]
-            final_world, resumed_from = tr.world, (
-                tr.events[-1].resumed_from if tr.events else None
-            )
+            # collapse the resize events into the FINAL trajectory's
+            # lineage: each event rolls back to resumed_from and replays,
+            # erasing any earlier segment that started at or past it
+            lineage = [(0, world)]
+            for ev in tr.events:
+                if ev.reason == "preemption_drain":
+                    continue
+                r = ev.resumed_from
+                lineage = (
+                    [e for e in lineage if e[0] < r] + [(r, ev.new_world)]
+                )
+            final_rows = {}
+            for r in tr.history:      # last occurrence wins (replays)
+                final_rows[r["step"]] = r
+            tail = [
+                final_rows[s]
+                for s in range(lineage[-1][0] + 1, args.steps + 1)
+            ]
+            final_world = tr.world
 
         summary = ckpt_summary()
         hf = summary["hidden_fraction"]
@@ -160,32 +194,31 @@ def main(argv=None):
               f"{summary['background_s'] * 1e3:.1f} ms"
               + (f", hidden fraction {hf:.2f}" if hf is not None else ""))
 
-        if resumed_from is None:
+        if len(lineage) == 1:
             return
 
-        # the guarantee, demonstrated: an independent uninterrupted run
-        # resharded from the same generation matches the survived run
+        # the guarantee, demonstrated: a fault-free reference replaying the
+        # same lineage (run to each boundary, checkpoint synchronously,
+        # reshard to the segment's world) matches the survived run
         with ElasticTrainer(
             opt, layout, make_step, directory=f"{root}/ref",
             checkpoint_every=0,
         ) as ref:
-            ref.init(params, world=world)
-            ref.run(resumed_from, batch_fn)
-            ref.checkpoint_now(wait=True)
-        with ElasticTrainer(
-            opt, layout, make_step, directory=f"{root}/ref",
-            checkpoint_every=0,
-        ) as ref_small:
-            ref_small.restore(world=final_world)
-            ref_rows = ref_small.run(args.steps - resumed_from, batch_fn)
+            ref.init(params, world=lineage[0][1])
+            for start, w in lineage[1:]:
+                if start > ref.global_step:
+                    ref.run(start - ref.global_step, batch_fn)
+                ref.checkpoint_now(wait=True)
+                ref.restore(world=w)
+            ref_rows = ref.run(args.steps - ref.global_step, batch_fn)
             assert [r["loss"] for r in tail] == [
                 r["loss"] for r in ref_rows
             ], "survived trajectory diverged from the uninterrupted reference"
             assert np.array_equal(
-                survived, np.asarray(ref_small.state["master"])
+                survived, np.asarray(ref.state["master"])
             ), "survived master arena diverged"
-        print(f"verified: resumed-at-{final_world} run is bitwise identical "
-              "to an uninterrupted reference from the same generation")
+        print(f"verified: the survived run (final world {final_world}) is "
+              "bitwise identical to a fault-free replay of the same lineage")
 
 
 if __name__ == "__main__":

@@ -850,3 +850,225 @@ class TestGuardStateAcrossReshard:
             )
             tr4.run(1, batch)  # the re-seeded snapshot is usable
             assert tr4.global_step == 4
+
+
+# ---------------------------------------------------------------------------
+# multi-host checkpoint I/O (hosts=N partitioned writes, torn-host fallback)
+# ---------------------------------------------------------------------------
+
+
+class TestMultiHostCheckpoint:
+    def test_host_helpers(self):
+        assert zero3.host_rank_range(8, 2, 0) == range(0, 4)
+        assert zero3.host_rank_range(8, 2, 1) == range(4, 8)
+        with pytest.raises(ValueError, match="divide"):
+            zero3.host_rank_range(8, 3, 0)
+        with pytest.raises(ValueError, match="host"):
+            zero3.host_rank_range(8, 2, 2)
+        assert zero3.effective_hosts(8, 2) == 2
+        assert zero3.effective_hosts(1, 2) == 1
+        assert zero3.effective_hosts(6, 4) == 3
+
+    def test_hosts_must_divide_world(self, tmp_path):
+        params = {"w": np.zeros((8, 8), np.float32)}
+        layout = zero3.layout_of(params)
+        with pytest.raises(ValueError, match="divide"):
+            zero3.shard_manifest(layout, 4, hosts=3)
+        manifest = zero3.shard_manifest(layout, 4)
+        with pytest.raises(ValueError, match="divide"):
+            CheckpointManager(str(tmp_path), manifest, hosts=3)
+        with pytest.raises(ValueError, match="hosts"):
+            CheckpointManager(str(tmp_path), manifest, hosts=0)
+
+    def test_two_host_write_stamps_host_manifests(self, tmp_path):
+        params = {"w": np.zeros((8, 8), np.float32)}
+        layout = zero3.layout_of(params)
+        manifest = zero3.shard_manifest(layout, 4, hosts=2)
+        assert manifest["manifest_version"] == 2
+        assert zero3.manifest_hosts(manifest) == 2
+        state = _arena_state(manifest)
+        with CheckpointManager(str(tmp_path), manifest) as mgr:
+            gen = mgr.submit(3, state)
+            mgr.wait()
+        for h in (0, 1):
+            assert os.path.isfile(zero3.host_manifest_path(gen, h))
+        back, shards = zero3.load_shard_files(gen)
+        assert zero3.manifest_hosts(back) == 2
+        full = np.concatenate([s["master"] for s in shards])
+        np.testing.assert_array_equal(full, state["master"])
+
+    def test_torn_host_demotes_generation(self, tmp_path):
+        """Losing ONE host's manifest makes the generation non-durable:
+        list_generations demotes it, latest_generation falls back to the
+        last generation durable on ALL hosts, and a direct load of the
+        torn generation refuses loudly."""
+        params = {"w": np.zeros((8, 8), np.float32)}
+        layout = zero3.layout_of(params)
+        manifest = zero3.shard_manifest(layout, 4, hosts=2)
+        with CheckpointManager(str(tmp_path), manifest) as mgr:
+            g1 = mgr.submit(2, _arena_state(manifest))
+            mgr.wait()
+            g2 = mgr.submit(5, _arena_state(manifest))
+            mgr.wait()
+        assert latest_generation(str(tmp_path))[0] == 5
+        removed = faults.tear_host_generation(g2, 1)
+        assert not os.path.exists(removed)
+        durable = [(s, d) for s, _, d in list_generations(str(tmp_path))]
+        assert durable == [(2, True), (5, False)]
+        assert latest_generation(str(tmp_path))[0] == 2
+        with pytest.raises(FileNotFoundError, match="torn"):
+            zero3.load_shard_files(g2)
+        with pytest.raises(FileNotFoundError):
+            faults.tear_host_generation(g2, 1)   # already removed
+        back, _ = zero3.load_shard_files(g1)
+        assert back["step"] == 2
+
+    def test_v1_manifest_loads_with_defaults(self, tmp_path):
+        """PR-12 generations predate manifest_version/hosts: a manifest
+        without either key must keep loading (hosts defaults to 1, no
+        host manifests expected) — forward-compat is one-directional."""
+        params = {"w": np.zeros((8, 8), np.float32)}
+        layout = zero3.layout_of(params)
+        manifest = zero3.shard_manifest(layout, 2)
+        del manifest["manifest_version"], manifest["hosts"]
+        assert zero3.manifest_hosts(manifest) == 1
+        state = _arena_state(manifest)
+        with CheckpointManager(str(tmp_path), manifest) as mgr:
+            gen = mgr.submit(4, state)
+            mgr.wait()
+        assert not os.path.exists(zero3.host_manifest_path(gen, 0))
+        assert latest_generation(str(tmp_path))[0] == 4
+        back, shards = zero3.load_shard_files(gen)
+        assert zero3.manifest_hosts(back) == 1
+        full = np.concatenate([s["master"] for s in shards])
+        np.testing.assert_array_equal(full, state["master"])
+
+    def test_single_host_layout_is_v1_compatible(self, tmp_path):
+        """hosts=1 writes NO host manifests — byte-layout identical to the
+        PR-12 format, so old readers keep working on new writers."""
+        params = {"w": np.zeros((8, 8), np.float32)}
+        layout = zero3.layout_of(params)
+        manifest = zero3.shard_manifest(layout, 2, hosts=1)
+        with CheckpointManager(str(tmp_path), manifest) as mgr:
+            gen = mgr.submit(1, _arena_state(manifest))
+            mgr.wait()
+        assert sorted(os.listdir(gen)) == [
+            "manifest.json", "shard_00000.npz", "shard_00001.npz",
+        ]
+
+
+class TestWriterErrorNamesGeneration:
+    def test_failure_names_generation_and_previous_stays_restorable(
+            self, tmp_path):
+        """A writer-thread failure surfacing on the NEXT submit/wait must
+        name the generation that failed — and the previous durable
+        generation must still restore."""
+        manifest, _ = _tiny_manifest(world=2)
+        good = _arena_state(manifest, step=1)
+        bad = _arena_state(manifest)
+        bad["master"] = np.zeros(
+            (manifest["world"] * manifest["shard_len"] + 3,), np.float32
+        )
+        mgr = CheckpointManager(str(tmp_path), manifest)
+        g1 = mgr.submit(2, good)
+        mgr.wait()
+        mgr.submit(5, bad)
+        with pytest.raises(RuntimeError) as ei:
+            mgr.wait()
+        msg = str(ei.value)
+        assert "writer thread failed" in msg
+        assert "gen_00000005" in msg
+        assert "previous durable" in msg
+        mgr.close()
+        assert latest_generation(str(tmp_path)) == (2, g1)
+        back, _ = zero3.load_shard_files(g1)
+        assert back["step"] == 2
+
+
+# ---------------------------------------------------------------------------
+# resize-target validation + grow-back
+# ---------------------------------------------------------------------------
+
+
+class TestResizeValidationAndGrowback:
+    DIM, LAYERS, ROWS = 32, 2, 8
+
+    def _trainer(self, tmp_path, **kw):
+        params, layout, opt, make_step = eb._engine(self.DIM, self.LAYERS)
+        tr = ElasticTrainer(
+            opt, layout, make_step, directory=str(tmp_path),
+            checkpoint_every=2, **kw,
+        )
+        return params, tr
+
+    def test_invalid_targets_refuse_with_reasons(self, tmp_path):
+        params, tr = self._trainer(tmp_path)
+        with tr:
+            tr.init(params, world=4)
+            tr.run(2, eb._batch_fn(self.ROWS, self.DIM))
+            with pytest.raises(ValueError, match=">= 1"):
+                tr._resize(0, reason="manual")
+            with pytest.raises(ValueError, match="divide"):
+                tr._resize(3, reason="manual")
+            with pytest.raises(ValueError, match="equals the current"):
+                tr._resize(4, reason="manual")
+            with pytest.raises(ValueError, match="grow_when_available"):
+                tr._resize(8, reason="tripwire")
+            assert tr.world == 4   # nothing moved
+
+    def test_hosts_validation(self, tmp_path):
+        params, layout, opt, make_step = eb._engine(self.DIM, self.LAYERS)
+        with pytest.raises(ValueError, match="hosts"):
+            ElasticTrainer(
+                opt, layout, make_step, directory=str(tmp_path), hosts=0,
+            )
+
+    def test_growback_at_checkpoint_boundary_is_bitwise(self, tmp_path):
+        """Capacity returns mid-run; the trainer grows 4 -> 8 at the next
+        checkpoint boundary and the continued run matches a reference that
+        resharded the same generation."""
+        from beforeholiday_tpu.testing import chaos_bench as cb
+
+        out = cb.growback_drill(str(tmp_path), quick=True)
+        assert out["growback_resume_bitwise"] == 1.0
+        assert out["growback_stall_s"] > 0.0
+
+    def test_grow_target_picks_largest_divisor(self, tmp_path):
+        params, tr = self._trainer(
+            tmp_path, grow_when_available=True, capacity_probe=lambda: 8,
+        )
+        with tr:
+            tr.init(params, world=2)
+            assert tr._grow_target(8) == 8
+            assert tr._grow_target(7) == 4   # 7,6,5 don't divide 8
+            assert tr._grow_target(2) is None
+            assert tr._grow_target(1) is None
+
+
+# ---------------------------------------------------------------------------
+# the real-signal drain drill (subprocess; slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestGracefulDrainDrill:
+    def test_sigterm_drains_instead_of_redelivery(self, tmp_path):
+        """A REAL SIGTERM into an armed child: the flight recorder dumps
+        first, the preemption notice drains the writer, and the child exits
+        0 with the generation at the drained step durable — no re-raised
+        signal, no torn tail."""
+        ckpt = str(tmp_path / "ck")
+        dump = str(tmp_path / "dump.json")
+        proc = eb._spawn_train_child(ckpt, quick=True, extra_args=[
+            "--total", "15", "--term-at", "5", "--ckpt-every", "2",
+            "--hosts", "2", "--arm-notice", "--dump", dump,
+        ])
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        info = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert info["drained_at"] == 5
+        assert info["dumps"] == [dump]
+        assert os.path.isfile(dump)
+        with open(dump) as f:
+            payload = json.load(f)
+        assert payload["reason"].startswith("preemption:SIGTERM")
+        assert latest_generation(ckpt)[0] == 5

@@ -6,6 +6,7 @@ on the 8-device CPU mesh.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -189,3 +190,47 @@ class TestRankConsistency:
 
         out = jax.jit(f)(g)
         np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+class TestChaosInjectors:
+    """The PR-16 injectors: suppressed heartbeats and torn host manifests
+    (their end-to-end drills live in test_chaos.py / test_elastic.py —
+    here just the injector contracts)."""
+
+    def test_hang_rank_targets_one_rank_after_step(self):
+        from beforeholiday_tpu.elastic import HangWatchdog
+        from beforeholiday_tpu.testing.faults import hang_rank
+
+        wd = HangWatchdog(4, hang_timeout_s=5.0)
+        sup = hang_rank(wd, 1, after_step=3)
+        assert wd.beat(1, 2)       # before the onset step: alive
+        assert not wd.beat(1, 3)   # from after_step on: suppressed
+        assert wd.beat(0, 3) and wd.beat(2, 3) and wd.beat(3, 3)
+        wd.remove_suppressor(sup)  # the return value un-hangs the rank
+        assert wd.beat(1, 4)
+
+    def test_hang_rank_validates(self):
+        from beforeholiday_tpu.elastic import HangWatchdog
+        from beforeholiday_tpu.testing.faults import hang_rank
+
+        wd = HangWatchdog(2, hang_timeout_s=5.0)
+        with pytest.raises(ValueError, match="rank"):
+            hang_rank(wd, 2)
+        with pytest.raises(ValueError, match="rank"):
+            hang_rank(wd, -1)
+
+    def test_tear_host_generation(self, tmp_path):
+        from beforeholiday_tpu.optimizers import zero3
+        from beforeholiday_tpu.testing.faults import tear_host_generation
+
+        gen = tmp_path / "gen_00000002"
+        gen.mkdir()
+        target = zero3.host_manifest_path(str(gen), 1)
+        with open(target, "w") as f:
+            f.write("{}")
+        assert tear_host_generation(str(gen), 1) == target
+        assert not os.path.exists(target)
+        with pytest.raises(FileNotFoundError):
+            tear_host_generation(str(gen), 1)
+        with pytest.raises(FileNotFoundError):
+            tear_host_generation(str(gen), 0)   # never existed

@@ -57,6 +57,13 @@ _SANCTIONED_BY_FILE = {
     "elastic/checkpoint.py": frozenset(
         {"submit", "wait", "_write_generation"}
     ),
+    # forward-looking pins: neither file syncs today, and the sanction
+    # confines any future readback to the documented host-side entry points
+    # (the signal handler and the heartbeat/monitor path run OUTSIDE the
+    # step's data path by contract — anywhere else in these files a
+    # readback must fail the scan)
+    "elastic/signals.py": frozenset({"_handler"}),
+    "elastic/watchdog.py": frozenset({"_monitor_loop", "beat"}),
 }
 
 # file-scoped waivers for sync points that are part of a documented host-side
@@ -163,6 +170,7 @@ def test_monitor_package_is_scanned():
     assert set(_SANCTIONED_BY_FILE) == {
         "monitor/export.py", "monitor/trace.py", "monitor/flight.py",
         "infer/engine.py", "infer/batching.py", "elastic/checkpoint.py",
+        "elastic/signals.py", "elastic/watchdog.py",
     }
     assert _SANCTIONED_BY_FILE["monitor/export.py"] == {"drain", "flush", "_fetch"}
     assert _SANCTIONED_BY_FILE["monitor/trace.py"] == {"export"}
@@ -330,9 +338,20 @@ def test_elastic_is_scanned():
     )
     assert "elastic/checkpoint.py" in elastic_files
     assert "elastic/trainer.py" in elastic_files
+    assert "elastic/signals.py" in elastic_files
+    assert "elastic/watchdog.py" in elastic_files
     assert "elastic" not in _SKIP_DIRS
     assert _SANCTIONED_BY_FILE["elastic/checkpoint.py"] == {
         "submit", "wait", "_write_generation",
+    }
+    # the preemption bridge and the watchdog are host-side BY DESIGN, but
+    # only at their documented entry points: the async-signal-safe handler,
+    # the heartbeat, and the monitor scan — pinned so a readback anywhere
+    # else in those files (the tick/check polls especially, which run once
+    # per step) fails the scan
+    assert _SANCTIONED_BY_FILE["elastic/signals.py"] == {"_handler"}
+    assert _SANCTIONED_BY_FILE["elastic/watchdog.py"] == {
+        "_monitor_loop", "beat",
     }
     assert "elastic/trainer.py" not in _SANCTIONED_BY_FILE
     assert not any(path.startswith("elastic/") for path, _ in _WAIVED)
