@@ -1,0 +1,161 @@
+"""Grouped expert FFN — every expert's MLP as ONE batched einsum.
+
+The reference framework has no MoE story; the design here follows GShard's
+einsum formulation (Lepikhin et al. 2020 §3.2, see PAPERS.md): the E expert
+MLPs are stacked along a leading ``experts`` axis and applied to the
+dispatched ``[experts, capacity, d_model]`` activations as a single
+``ecd,edf->ecf`` contraction — one GEMM per projection regardless of expert
+count, no Python loop, no ragged shapes.
+
+Parameters are a single stacked tree (``wi (E,D,F)``, ``bi (E,F)``,
+``wo (E,F,D)``, ``bo (E,D)``): four big leaves, arena-friendly, so
+``FusedAdam``/ZeRO-3 shard and step them exactly like any dense layer's
+weights — an expert dimension is just another leading axis to the flat-arena
+optimizers.
+
+Tensor parallelism lives INSIDE the expert (Megatron expert-tensor-
+parallelism): ``wi`` column-sharded over ``d_ff``, ``wo`` row-sharded, one
+ledgered psum over the tensor axis after the second GEMM. Expert parallelism
+shards the LEADING axis instead and is the dispatch layer's business
+(``moe/dispatch.py``) — the two compose because they touch different axes of
+the same stacked tree.
+
+Under :func:`~beforeholiday_tpu.ops._autocast.quantized_compute` both GEMMs
+take the O6 tier (``ops.quantized.quantized_matmul`` vmapped over the expert
+axis — the custom-VJP kernel batches cleanly), with the same delayed-scaling
+state the dense layers use.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from beforeholiday_tpu.monitor import comms
+from beforeholiday_tpu.ops._autocast import quantized_enabled
+
+__all__ = [
+    "expert_ffn",
+    "expert_param_specs",
+    "init_experts",
+]
+
+_F32 = jnp.float32
+
+
+def init_experts(
+    key: jax.Array,
+    n_experts: int,
+    d_model: int,
+    d_ff: int,
+    *,
+    init_std: float = 0.02,
+    out_std: Optional[float] = None,
+) -> dict:
+    """Stacked expert-FFN parameter tree (fp32 masters). ``out_std`` scales
+    the output projection (pass the depth-scaled std the surrounding model
+    uses; defaults to ``init_std``)."""
+    k_i, k_o = jax.random.split(key)
+    o_std = init_std if out_std is None else out_std
+    E, D, F = n_experts, d_model, d_ff
+    return {
+        "wi": (jax.random.normal(k_i, (E, D, F), _F32) * init_std),
+        "bi": jnp.zeros((E, F), _F32),
+        "wo": (jax.random.normal(k_o, (E, F, D), _F32) * o_std),
+        "bo": jnp.zeros((E, D), _F32),
+    }
+
+
+def expert_param_specs(
+    *, expert_axis=None, tensor_axis=None
+) -> dict:
+    """PartitionSpecs for the stacked tree: experts over ``expert_axis``
+    (leading dim), Megatron column/row sharding over ``tensor_axis`` on the
+    ``d_ff`` dim. Either axis may be None (replicated)."""
+    e, t = expert_axis, tensor_axis
+    return {
+        "wi": P(e, None, t),
+        "bi": P(e, t),
+        "wo": P(e, t, None),
+        "bo": P(e, None),
+    }
+
+
+def _grouped_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """``(E, C, D) x (E, D, F) -> (E, C, F)`` in x's dtype with fp32
+    accumulation; under ``quantized_compute()`` the O6 fp8-style GEMM,
+    vmapped over the expert axis (``quantized_matmul`` wants 2-D weights).
+
+    O6 caveat: the just-in-time activation scale is an amax over the LOCAL
+    per-expert slab, so the quantization grid depends on which tokens share
+    the slab — same-layout runs are deterministic-bitwise, but cross-layout
+    (ep=1 vs ep=4) O6 results agree only to fp8 quantization noise. The fp32
+    path is row-stable and carries the bitwise parity contract."""
+    if quantized_enabled():
+        from beforeholiday_tpu.ops.quantized import quantized_matmul
+
+        return jax.vmap(lambda a, b: quantized_matmul(a, b))(
+            x, w.astype(x.dtype)
+        ).astype(x.dtype)
+    return jnp.einsum(
+        "ecd,edf->ecf", x, w.astype(x.dtype), preferred_element_type=_F32
+    ).astype(x.dtype)
+
+
+def expert_ffn(
+    params: dict,
+    x: jax.Array,
+    *,
+    tensor_axis: Optional[str] = None,
+    emulate_tensor: int = 1,
+) -> jax.Array:
+    """Apply every (local) expert's gelu-MLP to its capacity batch.
+
+    ``x``: ``(E_local, C, D)`` dispatched activations. With ``tensor_axis``
+    bound (inside shard_map) the first GEMM is column-parallel over ``d_ff``
+    and the second row-parallel, closed by one ledgered psum — the classic
+    Megatron f/g pair, per expert. The psum site (``moe.experts.row_parallel``)
+    books against the comms ledger like every collective in the library.
+
+    ``emulate_tensor=tp`` spells the SAME computation a ``tp``-way tensor
+    split performs, on one device: ``d_ff`` column chunks through gelu, the
+    row-chunk partial products accumulated IN RANK ORDER (the CPU backend's
+    psum order, which the repo's collective engines pin) — the single-device
+    reference the distributed parity tests compare against bitwise. Mutually
+    exclusive with ``tensor_axis``.
+
+    Bitwise contract: the per-row computation is independent of ``E_local``
+    and ``C`` (row-stable batched GEMMs), so dispatch-order permutations and
+    capacity padding never change a kept token's output — the property the
+    expert-parallel parity oracle in ``moe/dispatch.py`` relies on."""
+    if emulate_tensor > 1:
+        if tensor_axis is not None:
+            raise ValueError("emulate_tensor is the SINGLE-device spelling; "
+                             "pass one of tensor_axis / emulate_tensor")
+        F = params["wi"].shape[-1]
+        if F % emulate_tensor != 0:
+            raise ValueError(
+                f"d_ff ({F}) must divide the emulated tensor world "
+                f"({emulate_tensor})"
+            )
+        chunk = F // emulate_tensor
+        y = None
+        for r in range(emulate_tensor):
+            sl = slice(r * chunk, (r + 1) * chunk)
+            h = _grouped_matmul(x, params["wi"][:, :, sl])
+            h = h + params["bi"][:, sl].astype(x.dtype)[:, None, :]
+            h = jax.nn.gelu(h)
+            part = _grouped_matmul(h, params["wo"][:, sl, :])
+            y = part if y is None else y + part
+        return y + params["bo"].astype(x.dtype)[:, None, :]
+    h = _grouped_matmul(x, params["wi"]) + params["bi"].astype(x.dtype)[:, None, :]
+    h = jax.nn.gelu(h)
+    y = _grouped_matmul(h, params["wo"])
+    if tensor_axis is not None:
+        y = comms.psum(y, tensor_axis, site="moe.experts.row_parallel")
+    # row-parallel convention: bias applied once, after the reduction
+    return y + params["bo"].astype(x.dtype)[:, None, :]

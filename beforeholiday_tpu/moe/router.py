@@ -1,0 +1,202 @@
+"""Top-k router with capacity-factor token dropping — the GShard/Switch recipe.
+
+The canonical TPU Mixture-of-Experts recipe (Lepikhin et al. 2020, "GShard:
+Scaling Giant Models with Conditional Computation and Automatic Sharding";
+Fedus et al. 2021, "Switch Transformers" — see PAPERS.md) routes each token
+to its top-1 or top-2 experts, subject to a STATIC per-expert capacity so the
+dispatched tensor keeps a fixed ``[experts, capacity, d_model]`` shape under
+jit. Tokens that overflow an expert's capacity are dropped from the expert
+computation and pass through the residual connection unchanged — the combine
+weights for a dropped token are all-zero, so the MoE layer contributes
+nothing and the residual carries the token (exactly Switch §2.2's "dropped
+tokens" semantics).
+
+Everything here is pure jnp over a single routing GROUP — the tokens local to
+one rank. Routing a group is deliberately mesh-independent: the same
+``(T, E)`` logits produce bit-identical dispatch/combine tensors whatever the
+expert-parallel world size, which is what makes the expert-parallel path in
+``moe/dispatch.py`` provable bitwise against a single-device oracle.
+
+Slot assignment is first-choice-first (GShard §3.2): first choices claim
+capacity slots in token order via a cumulative sum, second choices fill the
+remaining slots. The cumsum makes dropping deterministic and position-based
+(earlier tokens win), not score-based.
+
+Two auxiliary losses ride along and surface as ``TrainMonitor`` metrics keys
+(``moe_aux_loss`` / ``moe_z_loss`` / ``moe_drop_fraction``):
+
+* the load-balance loss ``E * sum_e f_e * P_e`` (Switch eq. 4): ``f_e`` the
+  fraction of tokens whose FIRST choice is expert ``e`` (non-differentiable,
+  a constant under grad), ``P_e`` the mean router probability — gradient
+  flows through ``P_e`` only;
+* the router z-loss ``mean(logsumexp(logits)^2)`` (ST-MoE, Zoph et al.
+  2022), keeping router logits from drifting into the softmax's saturated
+  region under bf16.
+
+No host syncs: capacity is a static Python int derived from static shapes,
+every decision is a traced comparison (``tests/test_no_host_sync.py`` scans
+this package with zero sanctions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "MoEConfig",
+    "RouterDecision",
+    "dense_gates",
+    "route",
+    "router_logits",
+]
+
+_F32 = jnp.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Static MoE hyperparameters (hashable: rides in jit closures)."""
+
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 1e-2   # load-balance loss weight (Switch uses 1e-2)
+    z_weight: float = 1e-3     # router z-loss weight (ST-MoE uses 1e-3)
+
+    def __post_init__(self):
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
+        if self.n_experts < 2:
+            raise ValueError(f"need >= 2 experts, got {self.n_experts}")
+
+    def capacity(self, n_tokens: int) -> int:
+        """Static per-expert slot count for a ``n_tokens`` routing group:
+        ``ceil(top_k * n_tokens / n_experts * capacity_factor)`` (GShard's
+        expert capacity), floored at 1 so tiny groups stay routable."""
+        return max(
+            1,
+            math.ceil(
+                self.top_k * n_tokens * self.capacity_factor / self.n_experts
+            ),
+        )
+
+
+class RouterDecision(NamedTuple):
+    """One group's routing outcome. ``dispatch``/``combine`` are
+    ``(T, E, capacity)`` fp32: ``dispatch`` is the 0/1 slot assignment,
+    ``combine`` carries the gate values on the same slots (all-zero rows =
+    dropped tokens). The scalars are this group's metrics: the two auxiliary
+    losses and the fraction of (token, choice) assignments dropped."""
+
+    dispatch: jax.Array
+    combine: jax.Array
+    aux_loss: jax.Array
+    z_loss: jax.Array
+    drop_fraction: jax.Array
+
+
+def router_logits(x: jax.Array, w_router: jax.Array) -> jax.Array:
+    """``(T, D) @ (D, E) -> (T, E)`` router logits, computed in fp32
+    regardless of the activation dtype — GShard/Switch both pin the router
+    to full precision because the argmax and the softmax normalizer are
+    precision-sensitive in a way the FFN body is not."""
+    return jnp.einsum(
+        "td,de->te",
+        x.astype(_F32),
+        w_router.astype(_F32),
+        preferred_element_type=_F32,
+    )
+
+
+def _topk(
+    logits: jax.Array, cfg: MoEConfig
+) -> Tuple[List[Tuple[jax.Array, jax.Array]], jax.Array, jax.Array]:
+    """Shared top-k core: per-choice ``(mask (T,E), gate (T,))`` pairs plus
+    the two auxiliary losses. Used by both the capacity path (:func:`route`)
+    and the dense no-drop oracle (:func:`dense_gates`), so the two paths
+    cannot drift."""
+    T, E = logits.shape
+    logits = logits.astype(_F32)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # router z-loss: mean squared softmax normalizer (ST-MoE eq. 5)
+    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+
+    e1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(e1, E, dtype=_F32)
+    g1 = jnp.sum(probs * mask1, axis=-1)
+
+    # load-balance loss over FIRST choices (Switch eq. 4): f_e is a count of
+    # argmaxes (constant under grad), P_e the mean probability (carries grad)
+    f = jnp.mean(mask1, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(f * p)
+
+    if cfg.top_k == 1:
+        return [(mask1, g1)], aux_loss, z_loss
+
+    e2 = jnp.argmax(probs * (1.0 - mask1), axis=-1)
+    mask2 = jax.nn.one_hot(e2, E, dtype=_F32)
+    g2 = jnp.sum(probs * mask2, axis=-1)
+    # GShard normalizes the two gates to sum to 1 over the selected pair
+    denom = jnp.maximum(g1 + g2, jnp.asarray(1e-9, _F32))
+    return [(mask1, g1 / denom), (mask2, g2 / denom)], aux_loss, z_loss
+
+
+def route(logits: jax.Array, cfg: MoEConfig, capacity: int) -> RouterDecision:
+    """Route one group: ``(T, E)`` logits -> :class:`RouterDecision` with
+    static per-expert ``capacity``.
+
+    First-choice-first assignment: choice-1 tokens claim slots in token
+    order (``cumsum`` positions), kept first choices occupy a contiguous
+    ``[0, kept_1)`` prefix per expert, and choice-2 positions start at that
+    offset — so the two choices can never collide on a slot and the whole
+    decision is a deterministic function of the logits alone."""
+    T, E = logits.shape
+    choices, aux_loss, z_loss = _topk(logits, cfg)
+
+    used = jnp.zeros((E,), _F32)          # kept assignments so far, per expert
+    dispatch = jnp.zeros((T, E, capacity), _F32)
+    combine = jnp.zeros((T, E, capacity), _F32)
+    kept_total = jnp.zeros((), _F32)
+    for mask, gate in choices:
+        # 0-based slot index per (token, chosen expert): my position among
+        # this choice's tokens for that expert, offset by the slots earlier
+        # choices already filled
+        pos = jnp.cumsum(mask, axis=0) - mask + used[None, :]
+        keep = mask * (pos < capacity)
+        used = used + jnp.sum(keep, axis=0)
+        kept_total = kept_total + jnp.sum(keep)
+        # slot one-hot over capacity; out-of-range indices (dropped tokens)
+        # one_hot to an all-zero row, and `keep` zeroes them anyway
+        slot = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)
+        slot_oh = jax.nn.one_hot(slot, capacity, dtype=_F32)
+        dis = keep[:, :, None] * slot_oh[:, None, :]
+        dispatch = dispatch + dis
+        combine = combine + dis * gate[:, None, None]
+
+    drop_fraction = 1.0 - kept_total / float(cfg.top_k * T)
+    return RouterDecision(dispatch, combine, aux_loss, z_loss, drop_fraction)
+
+
+def dense_gates(
+    logits: jax.Array, cfg: MoEConfig
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """No-drop dense gating: ``(T, E)`` gate matrix with each token's top-k
+    gates at its chosen experts and NO capacity dropping, plus the same
+    ``(aux_loss, z_loss)`` as :func:`route`.
+
+    This is the dense oracle's gate surface: at sufficient capacity
+    ``route(...).combine.sum(-1)`` equals this matrix bitwise (the slot
+    one-hots sum out exactly), which is the keystone of the dispatch/combine
+    bitwise-parity contract in ``moe/dispatch.py``."""
+    choices, aux_loss, z_loss = _topk(logits, cfg)
+    gates = jnp.zeros(logits.shape, _F32)
+    for mask, gate in choices:
+        gates = gates + mask * gate[:, None]
+    return gates, aux_loss, z_loss

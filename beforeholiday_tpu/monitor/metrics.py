@@ -82,6 +82,12 @@ class TrainMonitor:
         ("rollbacks_total", _I32, "max"),
         ("last_skip_reason", _I32, "max"),
         ("bn_shift_dominated", _I32, "max"),
+        # MoE router observability (beforeholiday_tpu.moe): the load-balance
+        # and z losses plus the capacity-drop fraction, mean-reduced across
+        # ranks (each rank routes its own token group)
+        ("moe_aux_loss", _F32, "mean"),
+        ("moe_z_loss", _F32, "mean"),
+        ("moe_drop_fraction", _F32, "mean"),
     )
 
     def __init__(self, *, ema_decay: float = 0.99):
@@ -108,6 +114,7 @@ class TrainMonitor:
         new_params: Any = None,
         scaler_state: Optional[Dict[str, jax.Array]] = None,
         health: Optional[Dict[str, jax.Array]] = None,
+        moe: Optional[Dict[str, jax.Array]] = None,
     ) -> Metrics:
         """Fold one step's observations into the pytree. Pure jnp — safe under
         jit/shard_map/vmap. Every argument is optional: pass what the step
@@ -152,6 +159,12 @@ class TrainMonitor:
                 m["update_ratio"] = u / jnp.maximum(p, 1e-12)
         if scaler_state is not None:
             m["loss_scale"] = jnp.asarray(scaler_state["scale"], _F32)
+        if moe is not None:
+            # the aux dict moe_layer / GPT forward(return_aux=True) returns,
+            # keys matching the spec directly
+            for k in ("moe_aux_loss", "moe_z_loss", "moe_drop_fraction"):
+                if k in moe:
+                    m[k] = jnp.asarray(moe[k], _F32)
         if health is not None:
             for k in (
                 "skipped_total",

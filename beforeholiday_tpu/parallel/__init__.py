@@ -34,12 +34,14 @@ from beforeholiday_tpu.parallel.parallel_state import (
     carve_data_mesh,
     initialize_model_parallel,
     destroy_model_parallel,
+    make_moe_mesh,
     model_parallel_is_initialized,
     get_mesh,
     DATA_AXIS,
     TENSOR_AXIS,
     PIPE_AXIS,
     CONTEXT_AXIS,
+    EXPERT_AXIS,
 )
 
 __all__ = [
@@ -64,10 +66,12 @@ __all__ = [
     "sync_batch_norm",
     "initialize_model_parallel",
     "destroy_model_parallel",
+    "make_moe_mesh",
     "model_parallel_is_initialized",
     "get_mesh",
     "DATA_AXIS",
     "TENSOR_AXIS",
     "PIPE_AXIS",
     "CONTEXT_AXIS",
+    "EXPERT_AXIS",
 ]

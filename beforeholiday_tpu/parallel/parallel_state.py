@@ -53,6 +53,15 @@ INTRA_AXIS = "intra"
 
 HIERARCHICAL_AXES = (SLICE_AXIS, INTRA_AXIS)
 
+# Expert parallelism (GShard-style MoE, see beforeholiday_tpu.moe): experts
+# shard over their own mesh axis, orthogonal to data/tensor/pipe — the
+# dispatch/combine all_to_all runs over this axis only. Not part of
+# MESH_AXIS_NAMES: the standard mesh stays MoE-free; MoE workloads carve a
+# dedicated mesh with ``make_moe_mesh``.
+EXPERT_AXIS = "expert"
+
+MOE_MESH_AXIS_NAMES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, TENSOR_AXIS)
+
 
 @dataclasses.dataclass(frozen=True)
 class ParallelState:
@@ -432,6 +441,62 @@ def make_two_level_mesh(
         n_slices, slice_size
     )
     return Mesh(dev_array, HIERARCHICAL_AXES)
+
+
+# --- MoE (expert-parallel) mesh -------------------------------------------------------
+
+
+def make_moe_mesh(
+    data: int = 1,
+    tensor: int = 1,
+    pipeline: int = 1,
+    expert: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Carve a data x tensor x pipeline x expert mesh for MoE workloads.
+
+    Axis order is ``MOE_MESH_AXIS_NAMES`` — ``(pipe, data, expert, tensor)``,
+    tensor fastest-varying so TP peers stay ICI-adjacent (same placement
+    logic as ``initialize_model_parallel``), and the expert axis between
+    data and tensor (expert parallelism borrows data-parallel-adjacent
+    ranks, the Megatron expert-parallel convention). Degenerate (size-1)
+    axes are DROPPED from the mesh entirely, the same way the two-level
+    bucketing engines drop size-1 tiers (``bucketing._sized_axes``) — a
+    collective over an absent axis then fails loudly instead of silently
+    reducing over one rank. An all-ones carve degenerates to a single-device
+    ``(data,)`` mesh.
+
+    Like ``make_two_level_mesh`` this does NOT install global parallel
+    state: MoE workloads own their mesh explicitly (shard_map over the
+    returned mesh), composing with ``initialize_model_parallel`` only by
+    hand."""
+    if devices is None:
+        devices = jax.devices()
+    sizes = {
+        PIPE_AXIS: pipeline,
+        DATA_AXIS: data,
+        EXPERT_AXIS: expert,
+        TENSOR_AXIS: tensor,
+    }
+    for name, n in sizes.items():
+        if n < 1:
+            raise ValueError(f"{name} size must be >= 1, got {n}")
+    world = pipeline * data * expert * tensor
+    if len(devices) < world:
+        raise RuntimeError(
+            f"need {world} devices for a pipe={pipeline} x data={data} x "
+            f"expert={expert} x tensor={tensor} mesh, have {len(devices)}"
+        )
+    kept = [
+        (name, sizes[name]) for name in MOE_MESH_AXIS_NAMES if sizes[name] > 1
+    ]
+    if not kept:
+        kept = [(DATA_AXIS, 1)]
+    dev_array = np.asarray(devices[:world], dtype=object).reshape(
+        [n for _, n in kept]
+    )
+    return Mesh(dev_array, tuple(name for name, _ in kept))
 
 
 # --- elastic resize helpers -----------------------------------------------------------

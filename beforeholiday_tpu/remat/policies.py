@@ -54,6 +54,8 @@ __all__ = [
     "TAG_ATTN_OUT",
     "TAG_BLOCK",
     "TAG_FLASH_LSE",
+    "TAG_MOE_COMBINE",
+    "TAG_MOE_DISPATCH",
     "TAG_NORM_OUT",
     "ZERO3_GATHERED_TAG",
     "apply",
@@ -69,9 +71,15 @@ TAG_BLOCK = "remat.block"          # transformer block output (testing/gpt, bert
 TAG_NORM_OUT = "remat.norm_out"    # fused_layer_norm / fused_rms_norm output
 TAG_ATTN_OUT = "remat.attn_out"    # attention context (post-kernel, pre-proj)
 TAG_FLASH_LSE = "remat.flash_lse"  # flash-attention log-sum-exp residual
+# MoE all_to_all boundaries (moe/dispatch.py): saving the dispatched and
+# combined activations means backward re-runs the cheap expert einsums, not
+# the expert-parallel collectives
+TAG_MOE_DISPATCH = "remat.moe_dispatch"  # post-dispatch (E, C, D) activations
+TAG_MOE_COMBINE = "remat.moe_combine"    # post-combine expert outputs
 
 BOUNDARY_TAGS: Tuple[str, ...] = (
     TAG_BLOCK, TAG_NORM_OUT, TAG_ATTN_OUT, TAG_FLASH_LSE,
+    TAG_MOE_DISPATCH, TAG_MOE_COMBINE,
 )
 
 # ZeRO-3 param residency: ``optimizers.zero3`` tags every all-gathered param

@@ -1109,6 +1109,38 @@ def bench_chaos():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_moe():
+    """Mixture-of-Experts rungs on a 16-device virtual CPU mesh subprocess
+    (the only stage that needs the full pipe=2 x data=2 x expert=2 x
+    tensor=2 carve). The child pins the 4D-mesh MoE stack bitwise against
+    its single-device reference, the dispatch/combine all_to_all ledger
+    bytes against the exact analytic payload, the two-level hierarchical
+    routing (bitwise vs joint, per-tier DCN/ICI booking), and an executed
+    ring-attention + expert-parallel long-context rung (S=8192, plus an
+    eval_shape-traced S=32768 byte oracle) — ALL before deriving the gated
+    keys: ``moe_vs_dense_step`` is the dual-engine replay makespan ratio of
+    the capacity-factor-1.25 MoE layer vs the dense every-expert oracle
+    (strictly below 1, asserted in the child). Same env scrub as
+    ``bench_pp_overhead``."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=16").strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.moe_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"moe_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_quantized():
     """O6 quantized-tier rungs on a CPU subprocess. The child pins the
     per-matmul quantized_matmul error inside its analytic bound, steps O5 and
@@ -1699,6 +1731,30 @@ def main():
             "the grow-back stall meter is wall-clock and reported ungated"
         )
         pass2.update(ch.get("pass2") or {})
+
+    # --- Mixture-of-Experts: 4D-mesh parity + routing traffic (subprocess) ---
+    mo = _stage(detail, bench_moe)
+    if mo:
+        for k in ("moe_4d_mesh_parity", "moe_dispatch_bytes_ratio",
+                  "moe_vs_dense_step", "moe_a2a_bytes", "moe_hier_dcn_bytes",
+                  "long_context_tokens", "long_context_analytic_tokens"):
+            detail[k] = mo.get(k)
+        detail["moe_bench"] = {
+            k: v for k, v in mo.items()
+            if k not in ("pass2", "compile_counters")
+        }
+        detail["moe_note"] = (
+            "16-device virtual CPU mesh: the 4D pipe x data x expert x "
+            "tensor MoE stack is pinned bitwise against its single-device "
+            "reference and the dispatch/combine all_to_all ledger bytes "
+            "against the exact analytic payload before anything prints; "
+            "moe_vs_dense_step is a deterministic dual-engine replay ratio "
+            "(conditional compute vs the every-expert dense oracle at "
+            "capacity factor 1.25), not TPU wall clock; the long-context "
+            "rung composes ring attention with expert-parallel MoE over "
+            "the same 8 ranks at S=8192 executed / S=32768 traced"
+        )
+        pass2.update(mo.get("pass2") or {})
 
     # --- guard dispatch + comms + compile counters: what every rung above
     # actually dispatched/communicated/compiled (collected LAST so the

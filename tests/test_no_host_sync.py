@@ -379,3 +379,22 @@ def test_quantized_tier_is_scanned():
         path.startswith(("ops/quantized", "transformer/tensor_parallel/"))
         for path, _ in _WAIVED
     )
+
+
+def test_moe_surface_is_scanned():
+    """The MoE subsystem promises routing with NO host syncs: capacity is a
+    static Python int from static shapes, every keep/drop decision is a
+    traced comparison, and the drop fraction surfaces as a Metrics key
+    instead of a readback. Pin that the whole package sits inside the
+    scanner's reach with ZERO file-scoped sanctions and ZERO waivers."""
+    moe_files = sorted(
+        p.relative_to(_PKG_ROOT).as_posix()
+        for p in (_PKG_ROOT / "moe").rglob("*.py")
+    )
+    assert "moe/router.py" in moe_files
+    assert "moe/experts.py" in moe_files
+    assert "moe/dispatch.py" in moe_files
+    for rel in moe_files:
+        assert pathlib.Path(rel).parts[0] not in _SKIP_DIRS
+        assert rel not in _SANCTIONED_BY_FILE
+        assert not any(path == rel for path, _ in _WAIVED)
