@@ -43,6 +43,7 @@ step like the examples do (``np.asarray``), never inside a traced function.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import time
@@ -62,6 +63,7 @@ else:
 
 from beforeholiday_tpu.elastic import checkpoint as ckpt
 from beforeholiday_tpu.elastic.watchdog import RankHangError
+from beforeholiday_tpu.monitor.trace import active_recorder
 from beforeholiday_tpu.optimizers import zero3
 from beforeholiday_tpu.parallel.parallel_state import (
     DATA_AXIS,
@@ -71,6 +73,20 @@ from beforeholiday_tpu.testing.faults import SimulatedPreemption
 from beforeholiday_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+def _span(name: str):
+    """Book ``name`` on the active timeline recorder (no-op otherwise) —
+    the goodput classifier's raw material (``monitor.goodput``). The loop
+    books ``step`` around productive work and ``elastic:drain`` /
+    ``elastic:restore`` / ``elastic:reshard`` / ``elastic:hang`` around the
+    resize machinery; the checkpoint ledger books its own ``ckpt:*`` phase
+    spans."""
+    rec = active_recorder()
+    if rec is None:
+        return contextlib.nullcontext()
+    return rec.span(name)
+
 
 __all__ = [
     "ElasticTrainer",
@@ -325,18 +341,20 @@ class ElasticTrainer:
                     self.notice.tick()
                 if self.watchdog is not None:
                     self.watchdog.check()
-                batch = batch_fn(self.global_step)
-                new_state, new_gstate, row = self._step_fn(
-                    self._state, self._gstate, batch
-                )
-                fetched = {k: np.asarray(v) for k, v in row.items()}
+                with _span("step"):
+                    batch = batch_fn(self.global_step)
+                    new_state, new_gstate, row = self._step_fn(
+                        self._state, self._gstate, batch
+                    )
+                    fetched = {k: np.asarray(v) for k, v in row.items()}
             except SimulatedPreemption as e:
                 if e.drain:
                     # graceful notice: this process is going away — make
                     # the state durable and hand control back (exit 0),
                     # instead of resizing a world that is being evicted
                     t0 = time.perf_counter()
-                    self.checkpoint_now(wait=True)
+                    with _span("elastic:drain"):
+                        self.checkpoint_now(wait=True)
                     self.events.append(ResizeEvent(
                         reason="preemption_drain", at_step=self.global_step,
                         old_world=self.world, new_world=self.world,
@@ -490,11 +508,15 @@ class ElasticTrainer:
             )
         old_world, at = self.world, self.global_step
         t0 = time.perf_counter()
-        if self._manager is not None:
-            # drain in-flight generations so the newest submitted one is
-            # durable before we go looking for it
-            self._manager.wait()
-        resumed = self.restore(world=new_world)
+        outer = "elastic:hang" if reason == "hang" else "elastic:reshard"
+        with _span(outer):
+            if self._manager is not None:
+                # drain in-flight generations so the newest submitted one is
+                # durable before we go looking for it
+                with _span("elastic:drain"):
+                    self._manager.wait()
+            with _span("elastic:restore"):
+                resumed = self.restore(world=new_world)
         self.events.append(ResizeEvent(
             reason=reason, at_step=at, old_world=old_world,
             new_world=new_world, resumed_from=resumed,

@@ -14,6 +14,10 @@ Built entirely on machinery the training stack already ships:
   admit/evict at decode-step granularity against the page budget, preempt
   by recompute on famine, plus the static-batching baseline the bench pairs
   it with.
+* :mod:`beforeholiday_tpu.infer.telemetry` — per-request lifecycle records,
+  mergeable latency histograms (TTFT / inter-token / e2e), Perfetto
+  request+counter tracks, and SLO burn-rate gates wired to the flight
+  recorder.
 
 The async open-loop request driver (with the crash flight recorder wired
 in) lives in ``examples/serve/``; the bench rungs in
@@ -29,6 +33,11 @@ from beforeholiday_tpu.infer.engine import (  # noqa: F401
     EngineConfig,
     InferenceEngine,
     pick_bucket,
+)
+from beforeholiday_tpu.infer.telemetry import (  # noqa: F401
+    RequestRecord,
+    ServingTelemetry,
+    SLOPolicy,
 )
 from beforeholiday_tpu.infer.kvcache import (  # noqa: F401
     KVCache,
@@ -51,6 +60,9 @@ __all__ = [
     "PageAllocator",
     "PagedLayout",
     "Request",
+    "RequestRecord",
+    "SLOPolicy",
+    "ServingTelemetry",
     "alloc_cache",
     "gather_pages",
     "pages_for",

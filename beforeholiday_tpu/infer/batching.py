@@ -29,7 +29,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 from beforeholiday_tpu.infer.engine import InferenceEngine
 from beforeholiday_tpu.infer.kvcache import PageAllocator, pages_for
@@ -73,13 +73,17 @@ class ContinuousBatcher:
     """
 
     def __init__(self, engine: InferenceEngine, *,
-                 now_fn: Callable[[], float] = time.perf_counter):
+                 now_fn: Callable[[], float] = time.perf_counter,
+                 telemetry: Optional[Any] = None):
         self.engine = engine
         self.allocator = PageAllocator(engine.cfg.num_pages)
         self.waiting: deque = deque()
         self.active: List[Request] = []
         self.finished: List[Request] = []
         self._now = now_fn
+        # passive lifecycle observer (infer/telemetry.ServingTelemetry); every
+        # hook receives this scheduler's own clock readings
+        self.telemetry = telemetry
         self._ps = engine.cfg.page_size
         # worst-case resident length: prompt + all-but-the-last generated
         # token (the final token is sampled, never cached)
@@ -107,6 +111,8 @@ class ContinuousBatcher:
                 f"request {req.rid}: needs more pages than the whole pool"
             )
         self.waiting.append(req)
+        if self.telemetry is not None:
+            self.telemetry.on_enqueue(req, self._now())
 
     # ------------------------------------------------------------- scheduling
 
@@ -126,6 +132,7 @@ class ContinuousBatcher:
             batch.append(self.waiting.popleft())
         if not batch:
             return
+        t0 = self._now()
         first = self.engine.prefill(
             [r.sequence for r in batch], [r.pages for r in batch]
         )
@@ -136,6 +143,8 @@ class ContinuousBatcher:
             if r.first_token_time is None:
                 r.first_token_time = t
         self.active.extend(batch)
+        if self.telemetry is not None:
+            self.telemetry.on_admit(batch, t, t - t0)
 
     def _preempt(self, victim: Request) -> None:
         self.active.remove(victim)
@@ -144,6 +153,8 @@ class ContinuousBatcher:
         victim.cached = 0
         victim.preemptions += 1
         self.waiting.appendleft(victim)
+        if self.telemetry is not None:
+            self.telemetry.on_preempt(victim, self._now())
 
     def _ensure_pages(self) -> None:
         """Every active request whose next write crosses a page boundary gets
@@ -168,6 +179,8 @@ class ContinuousBatcher:
         for r, tok in zip(self.active, nxt.tolist()):
             r.cached += 1
             r.out.append(tok)
+        if self.telemetry is not None:
+            self.telemetry.on_decode_tick(self.active, self._now())
 
     def step(self) -> List[Request]:
         """One scheduler iteration; returns the requests retired by it."""
@@ -176,7 +189,14 @@ class ContinuousBatcher:
         self._retire()  # a 1-token request is done straight out of prefill
         self._ensure_pages()
         self._decode()
-        return self._retire()
+        done = self._retire()
+        if self.telemetry is not None:
+            self.telemetry.on_step(
+                self._now(), free_pages=self.allocator.available,
+                active=len(self.active), waiting=len(self.waiting),
+                max_batch=self.engine.cfg.max_batch,
+            )
+        return done
 
     def _retire(self) -> List[Request]:
         done = [r for r in self.active if r.done]
@@ -189,6 +209,8 @@ class ContinuousBatcher:
             r.pages = []
         self.active = [r for r in self.active if not r.done]
         self.finished.extend(done)
+        if self.telemetry is not None:
+            self.telemetry.on_retire(done, t)
         return done
 
     def run(self, *, max_steps: Optional[int] = None) -> List[Request]:

@@ -39,6 +39,7 @@ The engine lives below ``testing/`` and imports only library code.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Any, Dict, Optional, Sequence, Tuple
@@ -49,6 +50,7 @@ import numpy as np
 
 from beforeholiday_tpu.infer import kvcache
 from beforeholiday_tpu.monitor.compile import _sig_of, track_compiles
+from beforeholiday_tpu.monitor.trace import active_recorder
 from beforeholiday_tpu.ops import flash_attention, fused_dense, fused_layer_norm
 from beforeholiday_tpu.ops._autocast import cast_floats
 from beforeholiday_tpu.remat.donation import donate_step
@@ -340,6 +342,16 @@ class InferenceEngine:
 
     # -- host surface --------------------------------------------------------
 
+    def _host_span(self, kind: str, **args):
+        """Span the host dispatch of one engine call on the active timeline
+        recorder (``infer.prefill`` / ``infer.decode`` with the chosen
+        bucket as args) — the serving telemetry's engine-side track. No-op
+        when no recorder is active."""
+        rec = active_recorder()
+        if rec is None:
+            return contextlib.nullcontext()
+        return rec.span(f"{self.cfg.entry_prefix}.{kind}", args=args)
+
     def _pad_tables(self, page_tables: Sequence[Sequence[int]], B: int):
         pt = np.zeros((B, self.cfg.n_slots), np.int32)
         for i, row in enumerate(page_tables):
@@ -370,11 +382,12 @@ class InferenceEngine:
             tokens[i, : len(p)] = p
             lens[i] = len(p)
         pt = self._pad_tables(page_tables, B)
-        nxt, _, self._cache = self._prefill_gated(
-            self._params, self._cache, jnp.asarray(tokens),
-            jnp.asarray(lens), jnp.asarray(pt),
-        )
-        return np.asarray(jax.device_get(nxt))[:n]
+        with self._host_span("prefill", batch=B, seq=S):
+            nxt, _, self._cache = self._prefill_gated(
+                self._params, self._cache, jnp.asarray(tokens),
+                jnp.asarray(lens), jnp.asarray(pt),
+            )
+            return np.asarray(jax.device_get(nxt))[:n]
 
     def decode(self, tokens: Sequence[int], lens: Sequence[int],
                page_tables: Sequence[Sequence[int]]) -> np.ndarray:
@@ -394,11 +407,12 @@ class InferenceEngine:
                 f"decode past max_seq_len {self.cfg.max_seq_len}"
             )
         pt = self._pad_tables(page_tables, B)
-        nxt, _, self._cache = self._decode_gated(
-            self._params, self._cache, jnp.asarray(tok),
-            jnp.asarray(ln), jnp.asarray(pt),
-        )
-        return np.asarray(jax.device_get(nxt))[:n]
+        with self._host_span("decode", batch=B):
+            nxt, _, self._cache = self._decode_gated(
+                self._params, self._cache, jnp.asarray(tok),
+                jnp.asarray(ln), jnp.asarray(pt),
+            )
+            return np.asarray(jax.device_get(nxt))[:n]
 
     def decode_logits(self, tokens: Sequence[int], lens: Sequence[int],
                       page_tables: Sequence[Sequence[int]]) -> np.ndarray:

@@ -108,11 +108,17 @@ from beforeholiday_tpu.monitor.flight import (  # noqa: F401
     FlightRecorder,
     active_flight_recorder,
 )
+from beforeholiday_tpu.monitor.histo import Histogram  # noqa: F401
+from beforeholiday_tpu.monitor.goodput import (  # noqa: F401
+    classify_span,
+    goodput_report,
+)
 
 __all__ = [
     "BucketGateError",
     "ChipSpec",
     "FlightRecorder",
+    "Histogram",
     "Metrics",
     "MetricsLogger",
     "Timers",
@@ -122,6 +128,7 @@ __all__ = [
     "active_recorder",
     "annotate",
     "chip_specs",
+    "classify_span",
     "comms_records",
     "comms_summary",
     "compile_counts",
@@ -132,6 +139,7 @@ __all__ = [
     "estimate_costs",
     "get_chip_spec",
     "global_norm",
+    "goodput_report",
     "join_spans",
     "ledger_scope",
     "measure_costs",

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, Optional, Union
 import jax
 import numpy as np
 
+from beforeholiday_tpu.monitor.histo import Histogram
 from beforeholiday_tpu.monitor.metrics import Metrics, TrainMonitor
 from beforeholiday_tpu.utils.logging import get_logger, warn_once
 
@@ -89,10 +90,27 @@ class MetricsLogger:
     def drain(self, metrics: Union[Metrics, jax.Array], step: int) -> Row:
         """Fetch + decode + export one row. Accepts either the packed vector
         (recommended — return it from the jitted step) or the metrics dict
-        (packed here first, still a single fetch)."""
-        packed = self.monitor.pack(metrics) if isinstance(metrics, dict) else metrics
+        (packed here first, still a single fetch). Histogram values in a
+        metrics dict are host objects already — they are split off before
+        packing and land as ``<name>_p50/_p95/_p99`` columns (plain floats;
+        jsonl rows are self-describing and csv schemas are fixed at the
+        first row, so readers of pre-histogram logs are unaffected)."""
+        histos: Dict[str, Histogram] = {}
+        if isinstance(metrics, dict):
+            scalars = {}
+            for k, v in metrics.items():
+                if isinstance(v, Histogram):
+                    histos[k] = v
+                else:
+                    scalars[k] = v
+            packed = self.monitor.pack(scalars)
+        else:
+            packed = metrics
         row = self.monitor.unpack_host(self._fetch(packed))
         row = {"step": int(step), **row}
+        for name, h in histos.items():
+            for q, tag in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                row[f"{name}_{tag}"] = h.quantile(q)
         self._write(row)
         if self.callback is not None:
             self.callback(int(step), row)

@@ -137,6 +137,20 @@ class TraceRecorder:
             ev["args"] = dict(args)
         self._append(ev)
 
+    def counter(self, name: str, value: Any, *, rank: int = 0) -> None:
+        """Counter-track sample (``C`` event): Perfetto renders one stacked
+        area chart per (pid, name) from these — the serving telemetry books
+        page occupancy, batch fill, and admission-queue depth this way.
+        ``value`` may be a number or a dict of series-name → number (multi-
+        series counters stack)."""
+        pid, tid = self._pid_tid(rank)
+        series = dict(value) if isinstance(value, dict) else {"value": value}
+        self._append({
+            "ph": "C", "name": name, "pid": pid, "tid": tid,
+            "ts": self._now_us(),
+            "args": {k: float(v) for k, v in series.items()},
+        })
+
     # -------------------------------------------------------------- queries
     def events(self) -> List[Dict[str, Any]]:
         """Snapshot of the raw event list (host dicts; no device values)."""

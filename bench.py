@@ -1141,6 +1141,35 @@ def bench_moe():
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def bench_telemetry():
+    """Telemetry rungs on the virtual 8-CPU mesh subprocess. The child
+    gates the serving observer's cost with paired telemetry-on/off replays
+    (``telemetry_overhead_vs_plain <= 1.05`` asserted in the child, token
+    streams identical both sides), trips the SLO burn-rate gate under an
+    injected prefill latency fault (flight dump with offender records
+    asserted on disk), and runs the seeded elastic fault schedule (preempt
+    8->4, grow back 4->8) under a live timeline, asserting the goodput
+    breakdown sums to wall time exactly before deriving
+    ``elastic_goodput_fraction``. Same env scrub as ``bench_elastic``."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "beforeholiday_tpu.testing.telemetry_bench"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"telemetry_bench failed: {out.stderr[-200:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_quantized():
     """O6 quantized-tier rungs on a CPU subprocess. The child pins the
     per-matmul quantized_matmul error inside its analytic bound, steps O5 and
@@ -1755,6 +1784,30 @@ def main():
             "the same 8 ranks at S=8192 executed / S=32768 traced"
         )
         pass2.update(mo.get("pass2") or {})
+
+    # --- telemetry: serving SLO numbers, observer overhead, goodput ledger ---
+    tl = _stage(detail, bench_telemetry)
+    if tl:
+        for k in ("telemetry_overhead_vs_plain", "serving_p99_ttft_ms",
+                  "serving_goodput_tokens_per_s", "elastic_goodput_fraction",
+                  "slo_breach_dump", "serving_preemptions",
+                  "serving_quantile_error_bound"):
+            detail[k] = tl.get(k)
+        detail["telemetry_bench"] = {
+            k: v for k, v in tl.items() if k != "pass2"
+        }
+        detail["telemetry_note"] = (
+            "8-CPU-mesh subprocess: the serving observer's cost is a "
+            "paired on/off replay ratio (child-asserted <= 1.05 with "
+            "bitwise-identical token streams), the SLO drill injects a "
+            "prefill latency fault and asserts the burn-rate breach wrote "
+            "a flight dump carrying the offending request records, and "
+            "the goodput leg replays the seeded preempt+grow-back "
+            "schedule under a live timeline with the breakdown asserted "
+            "to sum to wall time exactly; serving numbers are CPU trend "
+            "values, not TPU rates"
+        )
+        pass2.update(tl.get("pass2") or {})
 
     # --- guard dispatch + comms + compile counters: what every rung above
     # actually dispatched/communicated/compiled (collected LAST so the

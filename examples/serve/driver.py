@@ -45,6 +45,7 @@ from beforeholiday_tpu.infer import (
     EngineConfig,
     InferenceEngine,
     Request,
+    ServingTelemetry,
 )
 from beforeholiday_tpu.monitor import FlightRecorder
 from beforeholiday_tpu.testing import gpt
@@ -125,11 +126,15 @@ def serve(
     flight_path: str = "flight.json",
     flight_capacity: int = 64,
     fail_after_steps: Optional[int] = None,
+    telemetry: Optional[ServingTelemetry] = None,
 ) -> List[Request]:
     """Replay an open-loop trace through the continuous batcher; returns the
     finished requests. Any exception in the request loop auto-dumps the
-    flight recorder to ``flight_path`` before propagating."""
-    batcher = ContinuousBatcher(engine)
+    flight recorder to ``flight_path`` before propagating. Pass a
+    :class:`ServingTelemetry` to collect per-request lifecycle records and
+    latency histograms (its SLO policy, if any, dumps through the same
+    flight recorder on breach)."""
+    batcher = ContinuousBatcher(engine, telemetry=telemetry)
     recorder = FlightRecorder(
         flight_capacity, path=flight_path, auto_dump_on_rollback=False
     )
@@ -171,21 +176,25 @@ def main(argv=None) -> dict:
                      batch_buckets=(4, 8), prefill_seq_buckets=(32, 64)),
     )
     trace = synthetic_trace(args.requests, args.rate, seed=args.seed)
-    t0 = time.perf_counter()
+    telemetry = ServingTelemetry()
     finished = serve(
         trace, engine,
         flight_path=args.flight_path,
         fail_after_steps=args.fail_after_steps,
+        telemetry=telemetry,
     )
-    wall = time.perf_counter() - t0
-    tokens = sum(len(r.out) for r in finished)
-    lat = sorted(r.finish_time - r.arrival for r in finished)
+    # histogram-backed report: p50/p99 carry the analytic error bound
+    # instead of a raw-list sort, and throughput/goodput come pre-rolled
+    report = telemetry.serving_report()
     stats = {
         "requests": len(finished),
-        "tokens": tokens,
-        "tokens_per_s": tokens / wall,
-        "p50_ms": 1e3 * lat[len(lat) // 2],
-        "p99_ms": 1e3 * lat[min(len(lat) - 1, int(0.99 * len(lat)))],
+        "tokens": report["tokens_delivered"],
+        "tokens_per_s": report["tokens_per_s"],
+        "goodput_tokens_per_s": report["goodput_tokens_per_s"],
+        "ttft_p99_ms": report["ttft_p99_ms"],
+        "p50_ms": report["e2e_p50_ms"],
+        "p99_ms": report["e2e_p99_ms"],
+        "preemptions": report["preemptions"],
         "compile_counts": monitor.compile_counts(),
     }
     print(stats)

@@ -381,6 +381,27 @@ def test_quantized_tier_is_scanned():
     )
 
 
+def test_telemetry_surface_is_scanned():
+    """The telemetry trio (streaming histogram, goodput ledger, serving
+    request telemetry) promises pure host-side bookkeeping over values the
+    batcher/trainer ALREADY read back at their sanctioned boundaries — the
+    histogram's ``bucketize`` stays a pure jnp function whose counts come
+    home through the MetricsLogger drain, and the serving hooks take clock
+    readings as arguments instead of reading anything. Pin that all three
+    files sit inside the scanner's reach with ZERO file-scoped sanctions
+    and ZERO waivers — a future ``.item()`` on a bucketize result or a
+    ``float()`` on a drained subscript must fail this suite, not ship."""
+    for rel in (
+        "monitor/histo.py",
+        "monitor/goodput.py",
+        "infer/telemetry.py",
+    ):
+        assert (_PKG_ROOT / rel).is_file(), rel
+        assert pathlib.Path(rel).parts[0] not in _SKIP_DIRS
+        assert rel not in _SANCTIONED_BY_FILE
+        assert not any(path == rel for path, _ in _WAIVED)
+
+
 def test_moe_surface_is_scanned():
     """The MoE subsystem promises routing with NO host syncs: capacity is a
     static Python int from static shapes, every keep/drop decision is a

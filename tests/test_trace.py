@@ -226,6 +226,12 @@ def _check_nesting(events):
             stacks[key].pop()
         elif ph == "i":
             assert ev.get("s") in ("t", "p", "g")
+        elif ph == "C":
+            # counter samples carry numeric series in args and never touch
+            # the span stack
+            assert ev["args"], "counter event with no series"
+            assert all(isinstance(v, (int, float))
+                       for v in ev["args"].values())
         else:
             raise AssertionError(f"unexpected phase {ph!r}")
     assert all(not s for s in stacks.values()), f"unclosed spans: {stacks}"
@@ -296,15 +302,19 @@ class TestPerfettoMetadata:
     row naming and the deterministic export ordering."""
 
     def _cross_rank_trace(self):
-        """Nested spans on rank 0 overlapping in wall time with rank 1."""
+        """Nested spans on rank 0 overlapping in wall time with rank 1,
+        plus counter samples riding both ranks' tracks."""
         rec = monitor.TraceRecorder()
         rec.begin("step", rank=0)
+        rec.counter("pages_free", 61, rank=0)
         rec.begin("fwd", rank=0)
         rec.begin("step", rank=1)          # overlaps rank 0's open spans
         rec.end(rank=0)                    # close fwd
         rec.begin("psum:ddp.grads", rank=1)
+        rec.counter("queue", {"waiting": 3, "active": 5.0}, rank=1)
         rec.end(rank=1)
         rec.end(rank=0)                    # close rank 0's step
+        rec.counter("pages_free", 64, rank=0)
         rec.end(rank=1)                    # close rank 1's step
         return rec
 
@@ -360,6 +370,25 @@ class TestPerfettoMetadata:
         # ... then timed events in nondecreasing timestamp order
         ts = [e["ts"] for e in events[n_meta:]]
         assert ts == sorted(ts)
+
+    def test_counter_events_export_but_stay_out_of_span_analysis(self, tmp_path):
+        """'C' rows feed Perfetto counter tracks; the span analyzers must not
+        mistake them for B/E pairs and scalars normalise to a float series."""
+        rec = self._cross_rank_trace()
+        counters = [e for e in rec.events() if e["ph"] == "C"]
+        assert [(e["name"], e["pid"]) for e in counters] \
+            == [("pages_free", 0), ("queue", 1), ("pages_free", 0)]
+        assert counters[0]["args"] == {"value": 61.0}
+        assert counters[1]["args"] == {"waiting": 3.0, "active": 5.0}
+        # same spans reconstruct with and without the counter rows present
+        path = tmp_path / "trace.json"
+        rec.export(str(path))
+        events = json.loads(path.read_text())["traceEvents"]
+        timed = [e for e in events if e["ph"] != "M"]
+        ivs_with = monitor.span_intervals(events)
+        ivs_without = monitor.span_intervals(
+            [e for e in timed if e["ph"] != "C"])
+        assert ivs_with == ivs_without
 
     def test_exported_cross_rank_trace_round_trips_to_analyzers(self, tmp_path):
         """The exported JSON is the overlap/straggler engines' input format:
