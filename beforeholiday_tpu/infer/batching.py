@@ -33,6 +33,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 from beforeholiday_tpu.infer.engine import InferenceEngine
 from beforeholiday_tpu.infer.kvcache import PageAllocator, pages_for
+from beforeholiday_tpu.infer.radix import RadixCache
 
 __all__ = ["ContinuousBatcher", "Request", "static_batched_generate"]
 
@@ -74,7 +75,8 @@ class ContinuousBatcher:
 
     def __init__(self, engine: InferenceEngine, *,
                  now_fn: Callable[[], float] = time.perf_counter,
-                 telemetry: Optional[Any] = None):
+                 telemetry: Optional[Any] = None,
+                 prefix_cache: bool = False):
         self.engine = engine
         self.allocator = PageAllocator(engine.cfg.num_pages)
         self.waiting: deque = deque()
@@ -85,6 +87,15 @@ class ContinuousBatcher:
         # hook receives this scheduler's own clock readings
         self.telemetry = telemetry
         self._ps = engine.cfg.page_size
+        # prefix/radix caching (infer/radix.py): admitted prompts' full pages
+        # enter a host-side radix tree; later prompts sharing a full-page
+        # prefix alias those pages read-only and skip prefill past the match
+        # (the unmatched tail is teacher-forced through the decode
+        # executables — "decode-extend" — so the compiled signature set stays
+        # closed). Default OFF.
+        self.radix = (
+            RadixCache(self.allocator, self._ps) if prefix_cache else None
+        )
         # worst-case resident length: prompt + all-but-the-last generated
         # token (the final token is sampled, never cached)
         self._max_resident = min(
@@ -116,22 +127,86 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------- scheduling
 
-    def _admit(self, now: float) -> None:
-        room = self.engine.cfg.max_batch - len(self.active)
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Allocate with prefix-cache pressure relief: on famine, evict LRU
+        tree-only pages (a cheaper casualty than preempting a live request —
+        evicted prefixes re-prefill on the NEXT miss, preempted requests
+        replay unconditionally) and retry."""
+        got = self.allocator.alloc(n)
+        while got is None and self.radix is not None:
+            if not self.radix.evict(1):
+                break
+            got = self.allocator.alloc(n)
+        return got
+
+    def _try_extend(self, req: Request, now: float) -> bool:
+        """Prefix-cache admission: alias the matched full pages and enter
+        decode-extend (teacher-force the unmatched prompt tail, one token per
+        decode tick, batched with everyone else's decodes). When the WHOLE
+        prompt is cached, the tail page is copy-on-write duplicated instead
+        (``engine.copy_pages``) so only the last prompt token re-runs.
+        Returns False (nothing held) when there's no usable match or the
+        fresh-page ask can't be met."""
+        hit, m = self.radix.lookup(req.prompt)
+        if self.telemetry is not None and hasattr(
+            self.telemetry, "on_prefix_lookup"
+        ):
+            self.telemetry.on_prefix_lookup(
+                min(m, len(req.prompt)), len(req.prompt), now
+            )
+        if not hit:
+            return False
+        n_prompt = len(req.prompt)
+        copy_src = None
+        if m >= n_prompt:
+            # fully cached: the last page becomes the COW copy source — the
+            # final prompt token must re-run for its logits, and its KV write
+            # may only land on a page this request owns
+            copy_src = hit[-1]
+            hit = hit[:-1]
+        total = pages_for(len(req.sequence), self._ps)
+        fresh = self._alloc_pages(total - len(hit))
+        if fresh is None:
+            self.allocator.free(hit + ([copy_src] if copy_src else []))
+            return False
+        req.pages = hit + fresh
+        if copy_src is not None:
+            self.engine.copy_pages([copy_src], [fresh[0]])
+            self.allocator.free([copy_src])  # drop the lookup ref on the src
+            req.cached = n_prompt - 1
+        else:
+            req.cached = len(hit) * self._ps
+        return True
+
+    def _collect(self, now: float, room: int,
+                 prefill_cap: int) -> "tuple[List[Request], List[Request]]":
+        """Pull arrived FIFO work that fits: returns (batch, extended) —
+        newcomers needing a full prefill (≤ ``prefill_cap``, pages
+        allocated) and prefix hits already holding their aliased+fresh pages
+        (``room`` bounds the sum — the decode regime's capacity)."""
         batch: List[Request] = []
-        while self.waiting and len(batch) < room:
+        extended: List[Request] = []
+        while self.waiting and len(batch) + len(extended) < room:
             req = self.waiting[0]
             if req.arrival > now:
                 break  # open-loop: not yet arrived (FIFO — no reordering)
-            pages = self.allocator.alloc(
-                pages_for(len(req.sequence), self._ps)
-            )
+            if (self.radix is not None and not req.out
+                    and self._try_extend(req, now)):
+                extended.append(self.waiting.popleft())
+                continue
+            if len(batch) >= prefill_cap:
+                break  # this prefill is full; FIFO holds the rest
+            pages = self._alloc_pages(pages_for(len(req.sequence), self._ps))
             if pages is None:
                 break  # page famine: stop admitting, decode will free some
             req.pages = pages
             batch.append(self.waiting.popleft())
-        if not batch:
-            return
+        return batch, extended
+
+    def _run_prefill(self, batch: List[Request]) -> None:
+        """One bucketed prefill over ``batch`` + all bookkeeping (first
+        tokens, telemetry, radix adoption of the freshly-written prompt
+        pages)."""
         t0 = self._now()
         first = self.engine.prefill(
             [r.sequence for r in batch], [r.pages for r in batch]
@@ -142,9 +217,28 @@ class ContinuousBatcher:
             r.out.append(tok)
             if r.first_token_time is None:
                 r.first_token_time = t
-        self.active.extend(batch)
         if self.telemetry is not None:
             self.telemetry.on_admit(batch, t, t - t0)
+        if self.radix is not None:
+            # adopt the freshly-written full prompt pages right away — the
+            # very next admission can hit them
+            for r in batch:
+                self.radix.insert(r.prompt, r.pages)
+
+    def _admit(self, now: float) -> None:
+        batch, extended = self._collect(
+            now, self.engine.cfg.max_batch - len(self.active),
+            self.engine.cfg.max_prefill_batch,
+        )
+        if extended:
+            self.active.extend(extended)
+            if self.telemetry is not None and hasattr(
+                self.telemetry, "on_prefix_admit"
+            ):
+                self.telemetry.on_prefix_admit(extended, self._now())
+        if batch:
+            self._run_prefill(batch)
+            self.active.extend(batch)
 
     def _preempt(self, victim: Request) -> None:
         self.active.remove(victim)
@@ -162,25 +256,37 @@ class ContinuousBatcher:
         preempted request replays later from prompt+generated."""
         for r in list(self.active):
             while r in self.active and r.cached >= len(r.pages) * self._ps:
-                got = self.allocator.alloc(1)
+                got = self._alloc_pages(1)
                 if got is not None:
                     r.pages.extend(got)
                     break
                 self._preempt(self.active[-1])
 
     def _decode(self) -> None:
+        """One decode tick. Every active row feeds ``sequence[cached]`` at
+        position ``cached`` — for a steady-state request that IS its last
+        sampled token (``out[-1]``); for a decode-extend request it is the
+        next teacher-forced prompt token, whose predicted output is discarded
+        until the prompt is exhausted (the prediction for position
+        ``len(prompt)-1`` is the request's real first token)."""
         if not self.active:
             return
         nxt = self.engine.decode(
-            [r.out[-1] for r in self.active],
+            [r.sequence[r.cached] for r in self.active],
             [r.cached for r in self.active],
             [r.pages for r in self.active],
         )
+        t = self._now()
+        emitted: List[Request] = []
         for r, tok in zip(self.active, nxt.tolist()):
             r.cached += 1
-            r.out.append(tok)
-        if self.telemetry is not None:
-            self.telemetry.on_decode_tick(self.active, self._now())
+            if r.cached >= len(r.prompt):
+                r.out.append(tok)
+                if r.first_token_time is None:
+                    r.first_token_time = t
+                emitted.append(r)
+        if self.telemetry is not None and emitted:
+            self.telemetry.on_decode_tick(emitted, t)
 
     def step(self) -> List[Request]:
         """One scheduler iteration; returns the requests retired by it."""

@@ -74,10 +74,18 @@ class EngineConfig:
     num_pages: int = 65  # physical pages per layer, incl. the null page
     batch_buckets: Tuple[int, ...] = (4, 8)
     prefill_seq_buckets: Tuple[int, ...] = (32, 64, 128)
+    # decode-side batch buckets; None shares ``batch_buckets`` (the unified
+    # engine). A distinct set is the prefill/decode disaggregation knob: the
+    # two phases live in different roofline regimes (prefill compute-bound,
+    # decode bandwidth-bound), so prefill wants small admission chunks while
+    # decode wants one deep resident batch — see infer/disagg.py.
+    decode_batch_buckets: Optional[Tuple[int, ...]] = None
     # one-time weight cast at construction (e.g. "bfloat16"); None keeps the
     # checkpoint dtype. compute dtype follows the weights unless forced.
     weights_dtype: Optional[str] = None
     compute_dtype: Optional[str] = None
+    # "float32" (default) or "e4m3": fp8 pages under per-(layer, page)
+    # scales — see infer/kvcache.py's quantized variants
     cache_dtype: str = "float32"
     # strict=True promotes the recompile sentinel to the hard bucket gate
     strict_buckets: bool = True
@@ -91,6 +99,12 @@ class EngineConfig:
             )
         if tuple(sorted(self.batch_buckets)) != tuple(self.batch_buckets):
             raise ValueError(f"batch_buckets must ascend: {self.batch_buckets}")
+        if self.decode_batch_buckets is not None and tuple(
+            sorted(self.decode_batch_buckets)
+        ) != tuple(self.decode_batch_buckets):
+            raise ValueError(
+                f"decode_batch_buckets must ascend: {self.decode_batch_buckets}"
+            )
         if tuple(sorted(self.prefill_seq_buckets)) != tuple(
             self.prefill_seq_buckets
         ):
@@ -114,7 +128,19 @@ class EngineConfig:
         return self.max_seq_len // self.page_size
 
     @property
+    def decode_buckets(self) -> Tuple[int, ...]:
+        """The decode entry point's batch buckets (``batch_buckets`` unless
+        disaggregated)."""
+        return self.decode_batch_buckets or self.batch_buckets
+
+    @property
     def max_batch(self) -> int:
+        """Active-set capacity — how many requests decode can carry."""
+        return self.decode_buckets[-1]
+
+    @property
+    def max_prefill_batch(self) -> int:
+        """Largest batch one prefill call admits."""
         return self.batch_buckets[-1]
 
     @property
@@ -123,12 +149,22 @@ class EngineConfig:
 
     @property
     def declared_decode_signatures(self) -> int:
-        return len(self.batch_buckets)
+        return len(self.decode_buckets)
+
+    @property
+    def declared_copy_signatures(self) -> int:
+        """The COW tail-page copy is ONE fixed-shape executable (indices pad
+        to ``max_batch`` with the null page) — a single extra signature."""
+        return 1
 
     @property
     def declared_signatures(self) -> int:
         """Total compiled-signature budget — the bench's acceptance bound."""
-        return self.declared_prefill_signatures + self.declared_decode_signatures
+        return (
+            self.declared_prefill_signatures
+            + self.declared_decode_signatures
+            + self.declared_copy_signatures
+        )
 
 
 def pick_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -190,14 +226,16 @@ class InferenceEngine:
             dtype_name=cfg.cache_dtype,
         )
         self._cache = kvcache.alloc_cache(self.layout)
-        # donated step fns: the cache (arg 1) is consumed and re-emitted
+        # donated step fns: the cache (arg 1 / arg 0) is consumed and
+        # re-emitted
         self._prefill_step = donate_step(self._prefill_fn, donate_argnums=(1,))
         self._decode_step = donate_step(self._decode_fn, donate_argnums=(1,))
+        self._copy_step = donate_step(self._copy_fn, donate_argnums=(0,))
         # AOT executable cache, keyed by the sentinel's abstract signature
         # (the monitor/memory.py idiom: one .lower().compile() per signature,
         # plain dict dispatch after)
         self._exec: Dict[Any, Any] = {}
-        # the hard gate: both entries strict against their DECLARED budgets
+        # the hard gate: every entry strict against its DECLARED budget
         self._prefill_gated = track_compiles(
             f"{cfg.entry_prefix}.prefill",
             strict=cfg.strict_buckets,
@@ -208,6 +246,11 @@ class InferenceEngine:
             strict=cfg.strict_buckets,
             max_signatures=cfg.declared_decode_signatures,
         )(functools.partial(self._dispatch, "decode"))
+        self._copy_gated = track_compiles(
+            f"{cfg.entry_prefix}.copy",
+            strict=cfg.strict_buckets,
+            max_signatures=cfg.declared_copy_signatures,
+        )(functools.partial(self._dispatch, "copy"))
 
     # -- device-side step functions (traced; closures over static config) ----
 
@@ -252,19 +295,51 @@ class InferenceEngine:
         )
         return _vocab_head(x_last, params["tok_embed"])[:, 0, :]
 
+    def _scan_xs(self, params, cache):
+        """Per-layer scan slices: blocks + page pools (+ scale planes on
+        quantized layouts)."""
+        if self.layout.quantized:
+            return (params["blocks"], cache.k, cache.v,
+                    cache.k_scale, cache.v_scale)
+        return (params["blocks"], cache.k, cache.v)
+
+    def _rebuild(self, cache, ys):
+        """Reassemble the cache from the scan's stacked per-layer outputs."""
+        if self.layout.quantized:
+            k_new, v_new, ks_new, vs_new = ys
+            return cache.replace(k_new, v_new, ks_new, vs_new)
+        k_new, v_new = ys
+        return cache.replace(k_new, v_new)
+
     def _prefill_fn(self, params, cache, tokens, lens, page_table):
         """tokens (B, S_bucket) int32, lens (B,), page_table (B, n_slots).
-        Returns (next_tokens (B,), last_logits (B, V) fp32, cache)."""
+        Returns (next_tokens (B,), last_logits (B, V) fp32, cache).
+
+        Attention runs on the EXACT k/v just computed (not a quantized
+        round-trip) — prefill compute is full-precision either way; fp8
+        pages only affect later decode reads."""
         B, S = tokens.shape
         mc = self.model_cfg
         scale = 1.0 / np.sqrt(mc.head_dim)
         x = self._embed(params, tokens, jnp.arange(S))
+        quant = self.layout.quantized
 
         def body(carry, xs):
-            lp, kp, vp = xs
+            if quant:
+                lp, kp, vp, ks, vs = xs
+            else:
+                lp, kp, vp = xs
             q, k, v = self._qkv(lp, carry)
-            kp = kvcache.write_prefill(kp, page_table, k)
-            vp = kvcache.write_prefill(vp, page_table, v)
+            if quant:
+                kp, ks = kvcache.write_prefill_quantized(
+                    kp, ks, page_table, k
+                )
+                vp, vs = kvcache.write_prefill_quantized(
+                    vp, vs, page_table, v
+                )
+            else:
+                kp = kvcache.write_prefill(kp, page_table, k)
+                vp = kvcache.write_prefill(vp, page_table, v)
             ctx = flash_attention(
                 self._heads(q), self._heads(k), self._heads(v),
                 causal=True, scale=scale, kv_lens=lens,
@@ -272,35 +347,52 @@ class InferenceEngine:
             )
             carry = self._attn_out(lp, carry, self._merge_heads(ctx))
             carry = self._block_mlp(lp, carry)
-            return carry, (kp, vp)
+            return carry, ((kp, vp, ks, vs) if quant else (kp, vp))
 
-        x, (k_new, v_new) = jax.lax.scan(
-            body, x, (params["blocks"], cache.k, cache.v)
-        )
+        x, ys = jax.lax.scan(body, x, self._scan_xs(params, cache))
         last = jnp.clip(lens - 1, 0, S - 1).astype(jnp.int32)
         x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)
         logits = self._final_logits(params, x_last)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, \
-            cache.replace(k_new, v_new)
+            self._rebuild(cache, ys)
 
     def _decode_fn(self, params, cache, tokens, lens, page_table):
         """One incremental token. tokens (B,) = the last sampled token per
         row, lens (B,) = tokens already cached (the fed token's position);
         inactive rows carry lens == 0 + a null page table and are fully
-        masked. Returns (next_tokens (B,), logits (B, V) fp32, cache)."""
+        masked. Returns (next_tokens (B,), logits (B, V) fp32, cache).
+
+        On quantized layouts the fed token quantizes under its page's scale
+        (fresh scale when it OPENS the page) and the gather dequantizes
+        in-place to fp32 — the same tensor an fp32-cache engine feeds the
+        masked flash call."""
         B = tokens.shape[0]
         mc = self.model_cfg
         scale = 1.0 / np.sqrt(mc.head_dim)
         x = self._embed(params, tokens, lens)[:, None, :]  # (B, 1, D)
         kv_lens = jnp.where(lens > 0, lens + 1, 0)
+        quant = self.layout.quantized
 
         def body(carry, xs):
-            lp, kp, vp = xs
+            if quant:
+                lp, kp, vp, ks, vs = xs
+            else:
+                lp, kp, vp = xs
             q, k, v = self._qkv(lp, carry)
-            kp = kvcache.write_token(kp, page_table, lens, k[:, 0, :])
-            vp = kvcache.write_token(vp, page_table, lens, v[:, 0, :])
-            kc = kvcache.gather_pages(kp, page_table)
-            vc = kvcache.gather_pages(vp, page_table)
+            if quant:
+                kp, ks = kvcache.write_token_quantized(
+                    kp, ks, page_table, lens, k[:, 0, :]
+                )
+                vp, vs = kvcache.write_token_quantized(
+                    vp, vs, page_table, lens, v[:, 0, :]
+                )
+                kc = kvcache.gather_pages_quantized(kp, ks, page_table)
+                vc = kvcache.gather_pages_quantized(vp, vs, page_table)
+            else:
+                kp = kvcache.write_token(kp, page_table, lens, k[:, 0, :])
+                vp = kvcache.write_token(vp, page_table, lens, v[:, 0, :])
+                kc = kvcache.gather_pages(kp, page_table)
+                vc = kvcache.gather_pages(vp, page_table)
             ctx = flash_attention(
                 self._heads(q), self._heads(kc), self._heads(vc),
                 causal=False, scale=scale, kv_lens=kv_lens,
@@ -308,19 +400,35 @@ class InferenceEngine:
             )
             carry = self._attn_out(lp, carry, self._merge_heads(ctx))
             carry = self._block_mlp(lp, carry)
-            return carry, (kp, vp)
+            return carry, ((kp, vp, ks, vs) if quant else (kp, vp))
 
-        x, (k_new, v_new) = jax.lax.scan(
-            body, x, (params["blocks"], cache.k, cache.v)
-        )
+        x, ys = jax.lax.scan(body, x, self._scan_xs(params, cache))
         logits = self._final_logits(params, x)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, \
-            cache.replace(k_new, v_new)
+            self._rebuild(cache, ys)
+
+    def _copy_fn(self, cache, src, dst):
+        """Whole-page duplication ``dst[i] <- src[i]`` across all layers,
+        k+v pools (and scale planes): the copy-on-write tail copy of the
+        prefix cache. ``src``/``dst`` are (max_batch,) int32, padded with
+        the null page — a 0→0 copy is a no-op by construction. One fixed
+        shape, hence exactly one declared signature."""
+        k = cache.k.at[:, dst].set(cache.k[:, src])
+        v = cache.v.at[:, dst].set(cache.v[:, src])
+        if self.layout.quantized:
+            ks = cache.k_scale.at[:, dst].set(cache.k_scale[:, src])
+            vs = cache.v_scale.at[:, dst].set(cache.v_scale[:, src])
+            return cache.replace(k, v, ks, vs)
+        return cache.replace(k, v)
 
     # -- AOT dispatch --------------------------------------------------------
 
     def _dispatch(self, kind, *argv):
-        step = self._prefill_step if kind == "prefill" else self._decode_step
+        step = {
+            "prefill": self._prefill_step,
+            "decode": self._decode_step,
+            "copy": self._copy_step,
+        }[kind]
         key = (kind, _sig_of(argv, {}))
         compiled = self._exec.get(key)
         if compiled is None:
@@ -397,7 +505,7 @@ class InferenceEngine:
             return np.zeros((0,), np.int32)
         if not (n == len(lens) == len(page_tables)):
             raise ValueError("tokens/lens/page_tables length mismatch")
-        B = pick_bucket(n, self.cfg.batch_buckets)
+        B = pick_bucket(n, self.cfg.decode_buckets)
         tok = np.zeros((B,), np.int32)
         ln = np.zeros((B,), np.int32)
         tok[:n] = tokens
@@ -420,7 +528,7 @@ class InferenceEngine:
         correctness-oracle surface (tests compare these against a contiguous
         reference); shares executables with :meth:`decode`."""
         n = len(tokens)
-        B = pick_bucket(n, self.cfg.batch_buckets)
+        B = pick_bucket(n, self.cfg.decode_buckets)
         tok = np.zeros((B,), np.int32)
         ln = np.zeros((B,), np.int32)
         tok[:n] = tokens
@@ -431,3 +539,27 @@ class InferenceEngine:
             jnp.asarray(ln), jnp.asarray(pt),
         )
         return np.asarray(jax.device_get(logits))[:n]
+
+    def copy_pages(self, src: Sequence[int], dst: Sequence[int]) -> None:
+        """Duplicate whole pages ``src[i] → dst[i]`` inside the resident
+        arena — the prefix cache's copy-on-write: a fully-cached prompt
+        aliases every page but its tail, which is copied onto a fresh page
+        the request may then overwrite. Pads to ``max_batch`` with the null
+        page (0→0 is a no-op), so the call is one declared signature."""
+        n = len(src)
+        if n == 0:
+            return
+        if n != len(dst):
+            raise ValueError(f"{n} src pages vs {len(dst)} dst pages")
+        if n > self.cfg.max_batch:
+            raise ValueError(
+                f"copy_pages({n}) exceeds max_batch {self.cfg.max_batch}"
+            )
+        s = np.zeros((self.cfg.max_batch,), np.int32)
+        d = np.zeros((self.cfg.max_batch,), np.int32)
+        s[:n] = src
+        d[:n] = dst
+        with self._host_span("copy", pages=n):
+            self._cache = self._copy_gated(
+                self._cache, jnp.asarray(s), jnp.asarray(d)
+            )

@@ -164,6 +164,11 @@ class ServingTelemetry:
         self._preemptions = 0
         self._replays = 0
         self._steps = 0
+        # prefix-cache (radix) reuse counters — token- and request-level
+        self._prefix_lookups = 0
+        self._prefix_hits = 0
+        self._prefix_lookup_tokens = 0
+        self._prefix_hit_tokens = 0
         # SLO state: per-target (ts, ok) event streams + breach latches
         self._slo_events: Dict[str, Deque[Tuple[float, bool]]] = {}
         self._slo_offenders: Dict[str, List[Dict[str, Any]]] = {}
@@ -237,6 +242,31 @@ class ServingTelemetry:
             rec.last_token = now
         self._touch(now)
 
+    def on_prefix_lookup(self, hit_tokens: int, prompt_tokens: int,
+                         now: float) -> None:
+        """One radix-cache probe at admission: ``hit_tokens`` of the
+        ``prompt_tokens``-token prompt were served from shared pages
+        (0 on a miss)."""
+        self._prefix_lookups += 1
+        self._prefix_lookup_tokens += prompt_tokens
+        if hit_tokens > 0:
+            self._prefix_hits += 1
+            self._prefix_hit_tokens += hit_tokens
+        self._touch(now)
+
+    def on_prefix_admit(self, batch: List[Any], now: float) -> None:
+        """Prefix-hit requests entering decode-extend: admitted with NO
+        prefill and no token yet — the first real token (and TTFT) lands on
+        a later decode tick."""
+        for r in batch:
+            rec = self.records.get(r.rid)
+            if rec is None:
+                continue
+            if rec.admit is None:
+                rec.admit = now
+            self._span_switch(r.rid, "req:active")
+        self._touch(now)
+
     def on_preempt(self, req: Any, now: float) -> None:
         rec = self.records.get(req.rid)
         if rec is not None:
@@ -257,6 +287,16 @@ class ServingTelemetry:
                 if gap > 0.0:
                     self.itl.update(gap)
             rec.last_token = now
+            if rec.first_token is None and getattr(
+                r, "first_token_time", None
+            ) is not None:
+                # decode-extend requests earn their first token on a decode
+                # tick, not at admission
+                rec.first_token = r.first_token_time
+                ttft = rec.first_token - rec.enqueue
+                self.ttft.update(max(ttft, 0.0))
+                self._observe_slo("ttft_ms", ttft * 1e3, rec, now)
+                self._instant(r.rid, "first_token")
         self._touch(now)
 
     def on_retire(self, done: List[Any], now: float) -> None:
@@ -366,6 +406,13 @@ class ServingTelemetry:
             ),
             "preemptions": self._preemptions,
             "prefill_replays": self._replays,
+            "prefix_lookups": self._prefix_lookups,
+            "prefix_hits": self._prefix_hits,
+            "prefix_hit_rate": (
+                self._prefix_hit_tokens / self._prefix_lookup_tokens
+                if self._prefix_lookup_tokens else 0.0
+            ),
+            "prefix_hit_tokens": self._prefix_hit_tokens,
             "quantile_error_bound": self.ttft.quantile_error_bound,
         }
         for name, h in (("ttft", self.ttft), ("itl", self.itl),

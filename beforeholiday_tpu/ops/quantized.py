@@ -51,11 +51,15 @@ from beforeholiday_tpu.guard.dispatch import checked_impl as _checked_impl
 
 __all__ = [
     "E4M3_MAX",
+    "E4M3_REL",
+    "E4M3_TINY",
     "E5M2_MAX",
     "HISTORY_ROLES",
     "amax_of_tree",
     "init_amax_history",
+    "jit_scale_e4m3",
     "loss_parity_bound",
+    "quantize_e4m3",
     "quantized_matmul",
     "quantized_matmul_error_bound",
     "quantized_scope",
@@ -73,6 +77,11 @@ _E5M2_REL = 2.0 ** -3
 # smallest positive subnormals — the absolute-error floor under each format
 _E4M3_TINY = 2.0 ** -9
 _E5M2_TINY = 2.0 ** -16
+
+# public aliases: the e4m3 error model is shared with the fp8 KV-cache
+# (``infer/kvcache.py``), whose dequant bound composes the same two terms
+E4M3_REL = _E4M3_REL
+E4M3_TINY = _E4M3_TINY
 
 # delayed-scaled roles, in amax-history row order; activations are
 # just-in-time-scaled and carry no history
@@ -177,6 +186,25 @@ def _q_e4m3(a, scale):
 def _q_e5m2(a, scale):
     # NON-saturating: grad overflow becomes ±inf and is the found_inf signal
     return (a * scale).astype(E5M2)
+
+
+def jit_scale_e4m3(a, *, margin: float = 1.0) -> jax.Array:
+    """Public just-in-time e4m3 scale: amax -> ``E4M3_MAX / margin`` (1.0 for
+    an all-zero tensor). ``margin > 1`` leaves saturation headroom for values
+    written later under the same frozen scale — the fp8 KV-cache fixes each
+    page's scale at first write and saturates subsequent tokens, exactly the
+    delayed-scaling overflow contract."""
+    if margin < 1.0:
+        raise ValueError(f"margin must be >= 1.0, got {margin}")
+    return _jit_scale(a, E4M3_MAX / margin)
+
+
+def quantize_e4m3(a, scale):
+    """Public saturating e4m3 cast — ``clip(a * scale, ±E4M3_MAX)`` in e4m3.
+    Saturation (never inf/NaN) is the forward-operand contract; the clip
+    excess is exactly the term :func:`quantized_matmul_error_bound` and the
+    KV-cache's ``kv_dequant_error_bound`` charge for a stale scale."""
+    return _q_e4m3(a, scale)
 
 
 def _fp8_dot(qa, qb, dims):

@@ -290,6 +290,7 @@ def hierarchical_psum(
     *,
     site: str,
     bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    bucket_bytes_dcn: Optional[int] = None,
     compress_intra: bool = False,
     compress_dcn: bool = False,
     wire_dtype: Any = jnp.bfloat16,
@@ -305,7 +306,18 @@ def hierarchical_psum(
     inter-slice leg; accumulation stays fp32 on every tier and the
     composed error is within ``hierarchical_compression_error_bound``.
     Degenerate meshes (either axis size 1) collapse to the single-tier
-    bucketed path with that tier's compression knob — no extra collectives."""
+    bucketed path with that tier's compression knob — no extra collectives.
+
+    ``bucket_bytes_dcn`` sizes the DCN leg's collectives INDEPENDENTLY of
+    the ICI leg's (``None`` = DCN follows the ICI buckets, one psum per
+    bucket chunk — the historical behavior). DCN round-trip latency is
+    orders of magnitude above ICI, so the slow tier wants FEWER, BIGGER
+    collectives than the fast tier: the reduced 1/intra chunks of all ICI
+    buckets are re-bucketed at ``bucket_bytes_dcn`` granularity (consecutive
+    chunks concatenated, oversized runs split) and each re-bucket crosses
+    DCN as one collective. The per-element reduction is unchanged —
+    psum and the compressed exchange are both elementwise, so regrouping is
+    bitwise-invisible; only the ledger's per-tier ``calls`` count moves."""
     if flat.ndim != 1:
         raise ValueError(
             f"hierarchical_psum wants a flat arena, got {flat.shape}"
@@ -314,18 +326,34 @@ def hierarchical_psum(
     sized = _sized_axes(axes)
     if len(sized) < 2:
         # one (or zero) real tiers: the flat bucketed path IS the
-        # hierarchical one; keep the surviving tier's compression knob
+        # hierarchical one; keep the surviving tier's compression AND bucket
+        # size knobs (a slice-only mesh's collectives all cross DCN)
         if not sized:
             return flat
         ax, _ = sized[0]
+        on_dcn = ax == slice_axis
         return bucketed_psum(
-            flat, ax, site=site, bucket_bytes=bucket_bytes,
-            compress=(compress_dcn if ax == slice_axis else compress_intra),
+            flat, ax, site=site,
+            bucket_bytes=(
+                bucket_bytes_dcn
+                if on_dcn and bucket_bytes_dcn is not None else bucket_bytes
+            ),
+            compress=(compress_dcn if on_dcn else compress_intra),
             wire_dtype=wire_dtype,
         )
     intra = static_axis_size(intra_axis)
     slices = bucket_slices(flat.shape[0], flat.dtype.itemsize, bucket_bytes)
-    pieces = []
+
+    def _dcn_reduce(x):
+        if compress_dcn:
+            return _compressed_allreduce(
+                x, slice_axis, site=site, wire_dtype=wire_dtype
+            )
+        return comms.psum(x, slice_axis, site=site)
+
+    # leg 1 (ICI): per-bucket reduce-scatter down to the 1/intra chunk
+    reds = []
+    pads = []
     for off, ln in slices:
         piece = _slice_flat(flat, off, ln)
         chunk = -(-ln // intra)
@@ -342,12 +370,29 @@ def hierarchical_psum(
             red = comms.psum_scatter(
                 xp, intra_axis, scatter_dimension=0, tiled=True, site=site
             )
-        if compress_dcn:
-            red = _compressed_allreduce(
-                red, slice_axis, site=site, wire_dtype=wire_dtype
+        reds.append(red)
+        pads.append(pad)
+    # leg 2 (DCN): reduce the chunks across slices, regrouped to the DCN
+    # bucket size when one is set (elementwise -> bitwise-invariant)
+    if bucket_bytes_dcn is None:
+        reds = [_dcn_reduce(r) for r in reds]
+    else:
+        cat = reds[0] if len(reds) == 1 else jnp.concatenate(reds)
+        parts = [
+            _dcn_reduce(_slice_flat(cat, doff, dln))
+            for doff, dln in bucket_slices(
+                cat.shape[0], cat.dtype.itemsize, bucket_bytes_dcn
             )
-        else:
-            red = comms.psum(red, slice_axis, site=site)
+        ]
+        cat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        lens = [r.shape[0] for r in reds]
+        reds, o = [], 0
+        for ln in lens:
+            reds.append(jax.lax.slice_in_dim(cat, o, o + ln, axis=0))
+            o += ln
+    # leg 3 (ICI): per-bucket all-gather back to full bucket width
+    pieces = []
+    for (off, ln), red, pad in zip(slices, reds, pads):
         if compress_intra:
             g = comms.all_gather(
                 red.astype(wire_dtype), intra_axis, axis=0, tiled=True,
@@ -784,6 +829,7 @@ def bucketed_tree_psum(
     *,
     site: str,
     bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES,
+    bucket_bytes_dcn: Optional[int] = None,
     compress: bool = False,
     wire_dtype: Any = jnp.bfloat16,
     hierarchical: bool = False,
@@ -795,7 +841,8 @@ def bucketed_tree_psum(
     two-level axis spec the uncompressed groups reduce via the chained
     per-axis psum (the deterministic flat spelling); ``hierarchical=True``
     concatenates each float group and routes it through
-    ``hierarchical_psum`` instead, with per-tier compression knobs."""
+    ``hierarchical_psum`` instead, with per-tier compression knobs (and the
+    per-tier ``bucket_bytes_dcn`` DCN collective size)."""
     axes = hierarchical_axes(axis_name)
     if hierarchical and axes is None:
         raise ValueError(
@@ -817,6 +864,7 @@ def bucketed_tree_psum(
             if hierarchical:
                 red = hierarchical_psum(
                     flat, axes, site=site, bucket_bytes=None,
+                    bucket_bytes_dcn=bucket_bytes_dcn,
                     compress_intra=compress_intra, compress_dcn=compress_dcn,
                     wire_dtype=wire_dtype,
                 )
@@ -850,10 +898,14 @@ class BucketedReduce:
     accumulation. ``hierarchical=True`` (needs a two-level
     ``(slice, intra)`` ``axis_name``) routes reduces through the two-level
     engines — ``compress_intra``/``compress_dcn`` then compress each tier
-    independently (both default to ``compress`` when left ``None``)."""
+    independently (both default to ``compress`` when left ``None``), and
+    ``bucket_bytes_dcn`` sizes the DCN leg's collectives independently of
+    the ICI leg's (DCN wants bigger buckets — see ``hierarchical_psum``;
+    ``None`` keeps the one-DCN-psum-per-ICI-bucket behavior)."""
 
     axis_name: Any = DATA_AXIS
     bucket_bytes: Optional[int] = DEFAULT_BUCKET_BYTES
+    bucket_bytes_dcn: Optional[int] = None
     compress: bool = False
     wire_dtype: Any = jnp.bfloat16
     hierarchical: bool = False
@@ -865,6 +917,10 @@ class BucketedReduce:
             raise ValueError(
                 "hierarchical=True needs a (slice, intra) axis spec; got "
                 f"{self.axis_name!r}"
+            )
+        if self.bucket_bytes_dcn is not None and not self.hierarchical:
+            raise ValueError(
+                "bucket_bytes_dcn is a two-level knob; set hierarchical=True"
             )
 
     def _tier_compress(self) -> Tuple[bool, bool]:
@@ -879,7 +935,8 @@ class BucketedReduce:
             ci, cd = self._tier_compress()
             return hierarchical_psum(
                 flat, hierarchical_axes(self.axis_name), site=site,
-                bucket_bytes=self.bucket_bytes, compress_intra=ci,
+                bucket_bytes=self.bucket_bytes,
+                bucket_bytes_dcn=self.bucket_bytes_dcn, compress_intra=ci,
                 compress_dcn=cd, wire_dtype=self.wire_dtype,
             )
         return bucketed_psum(
@@ -918,7 +975,8 @@ class BucketedReduce:
         ci, cd = self._tier_compress()
         return bucketed_tree_psum(
             leaves, self.axis_name, site=site,
-            bucket_bytes=self.bucket_bytes, compress=self.compress,
+            bucket_bytes=self.bucket_bytes,
+            bucket_bytes_dcn=self.bucket_bytes_dcn, compress=self.compress,
             wire_dtype=self.wire_dtype, hierarchical=self.hierarchical,
             compress_intra=ci, compress_dcn=cd,
         )

@@ -1,0 +1,527 @@
+"""Serving-perf bench — fp8 KV pages, radix prefix caching, and
+prefill/decode disaggregation. Three composable rungs, each default-OFF in
+the engine and each pinned by a parity oracle BEFORE any timing:
+
+* **fp8 KV** (``cache_dtype="e4m3"``): a paired fp32/e4m3 engine drive —
+  greedy token streams must match exactly and the measured logit deviation
+  must sit inside the exported analytic ``kv_logit_error_bound`` at every
+  decode step; the headline ``kv_fp8_capacity_ratio`` (resident sequences
+  per page budget, fp32/fp8 cache bytes from the AOT memory probe) is gated
+  ``>= 1.8`` in the child.
+* **prefix/radix caching**: the prefix-heavy Zipf-family trace replayed
+  through the SAME engine with the radix cache on and off, interleaved —
+  token streams byte-identical both sides (asserted), hit rate exported,
+  and p99 TTFT with the cache ON gated strictly below the no-cache run
+  (``serving_prefix_p99_ttft_ms`` rides the ±10% stability gate).
+* **disaggregation** (``decode_batch_buckets``): a mixed bimodal workload
+  through a unified engine (one bucket set sized for decode depth) vs a
+  disaggregated engine (small prefill admission chunks, deep decode bucket)
+  under the decode-priority scheduler — streams identical (asserted), both
+  signature sets closed, ``serving_disagg_goodput_tokens_per_s`` gated
+  ``>=`` the unified baseline, and the roofline ledger must classify
+  prefill compute-bound / decode memory-bound on the proxy chip.
+
+Numbers are CPU proxies (XLA CPU executables, not a TPU) — ratios and the
+gated inequalities are the signal, absolute tokens/s is a trend number.
+
+Run as ``python -m beforeholiday_tpu.testing.serving_bench`` with
+``JAX_PLATFORMS=cpu``; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# model proxy: same tiny GPT as infer_bench
+VOCAB, POS, D_MODEL, HEADS, LAYERS = 512, 128, 128, 4, 2
+MAX_SEQ, PAGE_SIZE, NUM_PAGES = 64, 8, 65
+BATCH_BUCKETS, SEQ_BUCKETS = (8,), (8, 64)
+# disaggregated engine: small prefill admission chunks, one deep decode
+# bucket — fewer signatures than widening the unified set (buckets multiply
+# into prefill×seq AND decode under a shared set; the split declares each
+# phase's budget independently)
+DIS_PREFILL_BUCKETS, DIS_DECODE_BUCKETS = (2, 8), (8,)
+
+# fp8 parity drill: realistic prompt lengths, enough decode steps for drift
+# to show if the scales were wrong
+PARITY_PROMPTS, PARITY_STEPS = 4, 12
+
+# prefix-heavy trace: a long shared preamble (5 of at most 8 pages) over few
+# Zipf-weighted families, short per-request tails — the shape RadixAttention
+# exploits; arrivals far faster than service so admission-queue time (which
+# the cache shrinks by skipping prefill compute) dominates TTFT
+PREFIX_N_REQ, PREFIX_RATE_HZ = 64, 400.0
+PREFIX_TOKENS, PREFIX_FAMILIES = 40, 3
+PREFIX_TAIL, PREFIX_NEW = (4, 9), (4, 13)
+
+# mixed disagg trace: bimodal generation lengths at an arrival rate near the
+# service rate — the queue stays shallow, so the unified engine keeps paying
+# its full batch-8 prefill bucket for 1-3-request admissions while the
+# disaggregated engine admits on the 2-chunk bucket between decode steps
+DIS_N_REQ, DIS_RATE_HZ = 64, 60.0
+DIS_PROMPT = (8, 25)
+DIS_SHORT_NEW, DIS_LONG_NEW, DIS_LONG_FRAC = (4, 13), (30, 45), 0.25
+
+MEASURE_REPEATS = 3  # interleaved rounds × 2 passes × 2 arms per rung
+ROOFLINE_DECODE_STEPS = 16
+
+
+# ------------------------------------------------------------------- traces
+
+
+def _prefix_trace(seed: int):
+    from beforeholiday_tpu.infer import Request
+
+    rng = np.random.RandomState(seed)
+    families = [
+        list(map(int, rng.randint(1, VOCAB, PREFIX_TOKENS)))
+        for _ in range(PREFIX_FAMILIES)
+    ]
+    weights = 1.0 / np.arange(1, PREFIX_FAMILIES + 1)
+    weights /= weights.sum()
+    t, out = 0.0, []
+    for i in range(PREFIX_N_REQ):
+        t += float(rng.exponential(1.0 / PREFIX_RATE_HZ))
+        fam = families[int(rng.choice(PREFIX_FAMILIES, p=weights))]
+        tail = list(map(int, rng.randint(1, VOCAB,
+                                         rng.randint(*PREFIX_TAIL))))
+        out.append(Request(
+            rid=i, prompt=fam + tail,
+            max_new_tokens=int(rng.randint(*PREFIX_NEW)), arrival=t,
+        ))
+    return out
+
+
+def _mixed_trace(seed: int):
+    from beforeholiday_tpu.infer import Request
+
+    rng = np.random.RandomState(seed)
+    t, out = 0.0, []
+    for i in range(DIS_N_REQ):
+        t += float(rng.exponential(1.0 / DIS_RATE_HZ))
+        new = DIS_LONG_NEW if rng.random_sample() < DIS_LONG_FRAC \
+            else DIS_SHORT_NEW
+        out.append(Request(
+            rid=i,
+            prompt=list(map(int, rng.randint(1, VOCAB,
+                                             rng.randint(*DIS_PROMPT)))),
+            max_new_tokens=int(rng.randint(*new)), arrival=t,
+        ))
+    return out
+
+
+def _rebase(trace, base: float):
+    for r in trace:
+        r.arrival = base + r.arrival
+    return trace
+
+
+def _timed(fn, *args):
+    """One wall-timed run with the GC parked (same contract as infer_bench:
+    the schedulers churn Python lists and a mid-run collection is a
+    double-digit swing on a sub-second run)."""
+    gc.collect()
+    gc.disable()
+    try:
+        return fn(*args)
+    finally:
+        gc.enable()
+
+
+# --------------------------------------------------------- rung A: fp8 pages
+
+
+def _drive_locked(engine, prompts, steps):
+    """Greedy drive through prefill + ``steps`` decode_logits steps; returns
+    (token streams, per-step max|logit| ceiling, per-step logits list)."""
+    from beforeholiday_tpu.infer import PageAllocator, pages_for
+
+    engine.reset_cache()
+    alloc = PageAllocator(engine.cfg.num_pages)
+    tables = [alloc.alloc(pages_for(len(p), PAGE_SIZE)) for p in prompts]
+    toks = engine.prefill(prompts, tables).tolist()
+    lens = [len(p) for p in prompts]
+    streams = [[t] for t in toks]
+    step_logits = []
+    for _ in range(steps):
+        for i in range(len(prompts)):
+            while len(tables[i]) * PAGE_SIZE <= lens[i]:
+                tables[i] += alloc.alloc(1)
+        lg = engine.decode_logits(toks, lens, tables)
+        step_logits.append(np.asarray(lg, np.float32))
+        toks = [int(np.argmax(lg[i])) for i in range(len(prompts))]
+        lens = [n + 1 for n in lens]
+        for i, t in enumerate(toks):
+            streams[i].append(t)
+    return streams, step_logits
+
+
+def _cache_bytes(engine, entry):
+    """Resident KV-cache footprint via the AOT memory probe (argument bytes
+    of a jitted identity over the cache pytree); falls back to the leaf
+    nbytes sum when the backend offers no analysis."""
+    from beforeholiday_tpu import monitor
+
+    def ident(c):
+        return jax.tree_util.tree_map(lambda x: x + 0, c)
+
+    stats = monitor.measure_memory(ident, engine._cache, entry=entry)
+    probed = (stats or {}).get("argument_bytes")
+    if probed:
+        return float(probed), "memory_analysis"
+    return float(sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(engine._cache)
+        if hasattr(x, "nbytes")
+    )), "nbytes"
+
+
+def _rung_fp8(params, cfg):
+    """Paired fp32/e4m3 drive: token parity exact, logit deviation inside
+    the analytic bound at EVERY step, capacity ratio gated >= 1.8."""
+    from beforeholiday_tpu.infer import (
+        EngineConfig,
+        InferenceEngine,
+        kv_logit_error_bound,
+    )
+
+    mk = lambda dtype, prefix: InferenceEngine(params, cfg, EngineConfig(
+        max_seq_len=MAX_SEQ, page_size=PAGE_SIZE, num_pages=NUM_PAGES,
+        batch_buckets=BATCH_BUCKETS, prefill_seq_buckets=SEQ_BUCKETS,
+        cache_dtype=dtype, entry_prefix=prefix,
+    ))
+    ref = mk("float32", "serving_ref")
+    fp8 = mk("e4m3", "serving_fp8")
+
+    rng = np.random.RandomState(7)
+    prompts = [
+        list(map(int, rng.randint(1, VOCAB, rng.randint(20, 41))))
+        for _ in range(PARITY_PROMPTS)
+    ]
+    ref_streams, ref_logits = _drive_locked(ref, prompts, PARITY_STEPS)
+    fp8_streams, fp8_logits = _drive_locked(fp8, prompts, PARITY_STEPS)
+    assert ref_streams == fp8_streams, (
+        "fp8 KV diverged from the fp32 greedy trajectory"
+    )
+    ceiling = max(float(np.abs(lg).max()) for lg in ref_logits)
+    max_dev, max_ratio = 0.0, 0.0
+    for step, (a, b) in enumerate(zip(ref_logits, fp8_logits)):
+        dev = float(np.abs(a - b).max())
+        bound = kv_logit_error_bound(
+            step, n_layers=LAYERS, logit_ceiling=ceiling,
+        )
+        assert dev <= bound, (
+            f"step {step}: logit deviation {dev} outside bound {bound}"
+        )
+        max_dev = max(max_dev, dev)
+        max_ratio = max(max_ratio, dev / bound if bound else 0.0)
+
+    ref_bytes, ref_how = _cache_bytes(ref, "serving_ref_cache")
+    fp8_bytes, fp8_how = _cache_bytes(fp8, "serving_fp8_cache")
+    ratio = ref_bytes / fp8_bytes
+    assert ratio >= 1.8, (
+        f"fp8 capacity ratio {ratio:.2f} below the 1.8x gate "
+        f"({ref_bytes}/{fp8_bytes} via {ref_how}/{fp8_how})"
+    )
+    return {
+        "kv_fp8_capacity_ratio": round(ratio, 3),
+        "kv_fp8_cache_bytes": int(fp8_bytes),
+        "kv_fp32_cache_bytes": int(ref_bytes),
+        "kv_fp8_bytes_method": fp8_how,
+        "kv_fp8_logit_dev": round(max_dev, 6),
+        "kv_fp8_logit_bound_frac": round(max_ratio, 4),
+        "kv_fp8_parity_steps": PARITY_STEPS,
+    }, ref, fp8
+
+
+# ------------------------------------------------------ rung B: prefix cache
+
+
+def _run_prefix(engine, on: bool, seed: int = 0):
+    from beforeholiday_tpu.infer import ContinuousBatcher
+
+    engine.reset_cache()
+    bat = ContinuousBatcher(engine, prefix_cache=on)
+    base = time.perf_counter()
+    for r in _rebase(_prefix_trace(seed), base):
+        bat.submit(r)
+    fin = bat.run()
+    end = time.perf_counter()
+    assert all(len(r.out) == r.max_new_tokens for r in fin)
+    ttft = sorted(r.first_token_time - r.arrival for r in fin)
+    tokens = sum(len(r.out) for r in fin)
+    return {
+        "streams": [r.out for r in sorted(fin, key=lambda r: r.rid)],
+        "tokens": tokens,
+        "tokens_per_s": tokens / (end - base),
+        "ttft_p99_ms": 1e3 * ttft[min(len(ttft) - 1,
+                                      round(0.99 * (len(ttft) - 1)))],
+        "hit_rate": bat.radix.hit_rate if bat.radix is not None else 0.0,
+    }
+
+
+def _rung_prefix(engine):
+    """Radix cache on/off over the prefix-heavy trace, interleaved: byte
+    parity asserted, p99 TTFT gated strictly below the no-cache arm."""
+    # parity + warmup outside the timed window
+    on0 = _run_prefix(engine, True)
+    off0 = _run_prefix(engine, False)
+    assert on0["streams"] == off0["streams"], (
+        "prefix cache changed the token streams"
+    )
+    assert on0["hit_rate"] > 0.0, "prefix-heavy trace produced no hits"
+
+    samples = {(arm, p): [] for arm in ("on", "off") for p in (0, 1)}
+    for _ in range(MEASURE_REPEATS):
+        for p in (0, 1):
+            samples[("on", p)].append(_timed(_run_prefix, engine, True))
+            samples[("off", p)].append(_timed(_run_prefix, engine, False))
+
+    out, pass2 = {}, {}
+    for p, sink in ((0, out), (1, pass2)):
+        on = samples[("on", p)]
+        off = samples[("off", p)]
+        assert len({tuple(map(tuple, r["streams"])) for r in on + off}) == 1
+        on_p99 = min(r["ttft_p99_ms"] for r in on)
+        off_p99 = min(r["ttft_p99_ms"] for r in off)
+        assert on_p99 < off_p99, (
+            f"pass {p}: prefix-cache p99 TTFT {on_p99:.2f}ms not below "
+            f"no-cache {off_p99:.2f}ms"
+        )
+        sink["serving_prefix_p99_ttft_ms"] = round(on_p99, 2)
+        sink["prefix_vs_nocache_ttft"] = round(on_p99 / off_p99, 3)
+        if sink is out:
+            out["serving_nocache_p99_ttft_ms"] = round(off_p99, 2)
+            out["prefix_hit_rate"] = round(on[0]["hit_rate"], 4)
+            out["prefix_tokens_per_s"] = round(
+                max(r["tokens_per_s"] for r in on), 2)
+    return out, pass2
+
+
+# ---------------------------------------------------- rung C: disaggregation
+
+
+def _run_sched(engine, batcher_cls, seed: int = 0):
+    from beforeholiday_tpu.infer import ServingTelemetry
+
+    engine.reset_cache()
+    tel = ServingTelemetry()
+    bat = batcher_cls(engine, telemetry=tel)
+    base = time.perf_counter()
+    for r in _rebase(_mixed_trace(seed), base):
+        bat.submit(r)
+    fin = bat.run()
+    assert all(len(r.out) == r.max_new_tokens for r in fin)
+    rep = tel.serving_report()
+    ttft = sorted(r.first_token_time - r.arrival for r in fin)
+    return {
+        "streams": [r.out for r in sorted(fin, key=lambda r: r.rid)],
+        "tokens": rep["tokens_delivered"],
+        "goodput": rep["goodput_tokens_per_s"],
+        "ttft_p99_ms": 1e3 * ttft[min(len(ttft) - 1,
+                                      round(0.99 * (len(ttft) - 1)))],
+        "preemptions": rep["preemptions"],
+    }
+
+
+def _rung_disagg(params, cfg):
+    """Unified vs disaggregated scheduling of the same mixed trace: streams
+    identical, signature sets closed, disagg goodput >= unified."""
+    from beforeholiday_tpu.infer import (
+        ContinuousBatcher,
+        DisaggregatedBatcher,
+        EngineConfig,
+        InferenceEngine,
+    )
+
+    uni = InferenceEngine(params, cfg, EngineConfig(
+        max_seq_len=MAX_SEQ, page_size=PAGE_SIZE, num_pages=NUM_PAGES,
+        batch_buckets=BATCH_BUCKETS, prefill_seq_buckets=SEQ_BUCKETS,
+        entry_prefix="serving_uni",
+    ))
+    dis = InferenceEngine(params, cfg, EngineConfig(
+        max_seq_len=MAX_SEQ, page_size=PAGE_SIZE, num_pages=NUM_PAGES,
+        batch_buckets=DIS_PREFILL_BUCKETS,
+        decode_batch_buckets=DIS_DECODE_BUCKETS,
+        prefill_seq_buckets=SEQ_BUCKETS, entry_prefix="serving_dis",
+    ))
+
+    # parity + warmup (compiles both signature sets) outside the timed window
+    u0 = _run_sched(uni, ContinuousBatcher)
+    d0 = _run_sched(dis, DisaggregatedBatcher)
+    assert u0["streams"] == d0["streams"], (
+        "disaggregated scheduling changed the token streams"
+    )
+
+    samples = {(arm, p): [] for arm in ("uni", "dis") for p in (0, 1)}
+    for _ in range(MEASURE_REPEATS):
+        for p in (0, 1):
+            samples[("uni", p)].append(
+                _timed(_run_sched, uni, ContinuousBatcher))
+            samples[("dis", p)].append(
+                _timed(_run_sched, dis, DisaggregatedBatcher))
+
+    out, pass2 = {}, {}
+    for p, sink in ((0, out), (1, pass2)):
+        u = samples[("uni", p)]
+        d = samples[("dis", p)]
+        assert len({r["tokens"] for r in u + d}) == 1
+        ug = max(r["goodput"] for r in u)
+        dg = max(r["goodput"] for r in d)
+        assert dg >= ug, (
+            f"pass {p}: disagg goodput {dg:.1f} below unified {ug:.1f}"
+        )
+        sink["serving_disagg_goodput_tokens_per_s"] = round(dg, 2)
+        sink["disagg_vs_unified_goodput"] = round(dg / ug, 3)
+        if sink is out:
+            out["serving_unified_goodput_tokens_per_s"] = round(ug, 2)
+            out["serving_disagg_p99_ttft_ms"] = round(
+                min(r["ttft_p99_ms"] for r in d), 2)
+            out["serving_unified_p99_ttft_ms"] = round(
+                min(r["ttft_p99_ms"] for r in u), 2)
+    return out, pass2, uni, dis
+
+
+def _roofline_regimes(dis):
+    """Book one prefill and one decode signature of the disaggregated engine
+    into the roofline ledger and require the two regimes: prefill
+    compute-bound, decode memory-bound (cpu_proxy ridge — same chip as the
+    infer_bench MFU row; the classification is analytic intensity vs ridge,
+    wall time only feeds the reported MFU)."""
+    from beforeholiday_tpu import monitor
+    from beforeholiday_tpu.infer import PageAllocator, pages_for
+
+    dis.reset_cache()
+    alloc = PageAllocator(dis.cfg.num_pages)
+    B = DIS_DECODE_BUCKETS[-1]
+    plen = 8
+    prompts = [[1 + i] * plen for i in range(B)]
+    tables = [alloc.alloc(pages_for(plen, PAGE_SIZE)) for _ in prompts]
+
+    # prefill at the full (8, 64) signature — the compute-bound phase
+    S = SEQ_BUCKETS[-1]
+    tokens = np.zeros((B, S), np.int32)
+    lens_np = np.zeros((B,), np.int32)
+    for i, pr in enumerate(prompts):
+        tokens[i, : len(pr)] = pr
+        lens_np[i] = len(pr)
+    pt = jnp.asarray(dis._pad_tables(tables, B))
+    monitor.measure_costs(
+        dis._prefill_fn, dis._params, dis._cache, jnp.asarray(tokens),
+        jnp.asarray(lens_np), pt, entry="serving_prefill",
+    )
+    t0 = time.perf_counter()
+    toks = dis.prefill(prompts, tables).tolist()
+    monitor.record_wall_time(
+        "serving_prefill", time.perf_counter() - t0, steps=1)
+
+    # decode at the deep bucket — the bandwidth-bound phase
+    lens = [plen] * B
+    monitor.measure_costs(
+        dis._decode_fn, dis._params, dis._cache,
+        jnp.asarray(toks, jnp.int32), jnp.asarray(lens, jnp.int32),
+        jnp.asarray(dis._pad_tables(tables, B)), entry="serving_decode",
+    )
+    for i in range(B):
+        while len(tables[i]) * PAGE_SIZE <= lens[i] + ROOFLINE_DECODE_STEPS:
+            tables[i] += alloc.alloc(1)
+    t0 = time.perf_counter()
+    for _ in range(ROOFLINE_DECODE_STEPS):
+        toks = dis.decode(toks, lens, tables).tolist()
+        lens = [n + 1 for n in lens]
+    monitor.record_wall_time(
+        "serving_decode", time.perf_counter() - t0,
+        steps=ROOFLINE_DECODE_STEPS)
+
+    rows = {r["entry"]: r for r in monitor.roofline_summary(chip="cpu_proxy")}
+    pre, dec = rows["serving_prefill"], rows["serving_decode"]
+    assert pre["bound"] == "compute", pre
+    assert dec["bound"] == "memory", dec
+    return {
+        "serving_prefill_bound": pre["bound"],
+        "serving_decode_bound": dec["bound"],
+        "serving_prefill_intensity": round(
+            pre["intensity_flops_per_byte"], 2),
+        "serving_decode_intensity": round(
+            dec["intensity_flops_per_byte"], 2),
+        "serving_prefill_mfu": (
+            round(pre["mfu"], 5) if pre["mfu"] is not None else None),
+        "serving_decode_mfu": (
+            round(dec["mfu"], 5) if dec["mfu"] is not None else None),
+    }
+
+
+def _assert_closed(engines):
+    """The strict-gate contract over every engine this bench touched: the
+    executable cache and the gate-counted signatures must both sit inside
+    each engine's declared budget."""
+    from beforeholiday_tpu import monitor
+
+    counts = monitor.compile_counts()
+    for eng in engines:
+        ecfg = eng.cfg
+        gate_sigs = sum(
+            c["signatures"] for name, c in counts.items()
+            if name.startswith(ecfg.entry_prefix + ".")
+        )
+        assert eng.compiled_signatures <= ecfg.declared_signatures, (
+            ecfg.entry_prefix, eng.compiled_signatures,
+            ecfg.declared_signatures)
+        assert gate_sigs <= ecfg.declared_signatures, (
+            ecfg.entry_prefix, gate_sigs, ecfg.declared_signatures)
+
+
+def main():
+    from beforeholiday_tpu.testing import gpt
+
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            f"serving_bench expects the CPU backend, got "
+            f"{jax.default_backend()}"
+        )
+
+    cfg = gpt.GPTConfig(
+        vocab_size=VOCAB, seq_len=POS, d_model=D_MODEL, n_heads=HEADS,
+        n_layers=LAYERS, dtype=jnp.float32,
+    )
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+
+    out, pass2 = {}, {}
+
+    fp8_out, ref_eng, fp8_eng = _rung_fp8(params, cfg)
+    out.update(fp8_out)
+
+    prefix_out, prefix_p2 = _rung_prefix(ref_eng)
+    out.update(prefix_out)
+    pass2.update(prefix_p2)
+
+    dis_out, dis_p2, uni_eng, dis_eng = _rung_disagg(params, cfg)
+    out.update(dis_out)
+    pass2.update(dis_p2)
+    out.update(_roofline_regimes(dis_eng))
+
+    _assert_closed([ref_eng, fp8_eng, uni_eng, dis_eng])
+    out["serving_compiled_signatures"] = sum(
+        e.compiled_signatures for e in (ref_eng, fp8_eng, uni_eng, dis_eng))
+    out["serving_declared_signatures"] = sum(
+        e.cfg.declared_signatures for e in (ref_eng, fp8_eng, uni_eng,
+                                            dis_eng))
+
+    out["pass2"] = pass2
+    out["config"] = (
+        f"V={VOCAB} D={D_MODEL} H={HEADS} L={LAYERS} max_seq={MAX_SEQ} "
+        f"page={PAGE_SIZE} pages={NUM_PAGES} batch={BATCH_BUCKETS} "
+        f"seq={SEQ_BUCKETS} dis={DIS_PREFILL_BUCKETS}/{DIS_DECODE_BUCKETS} "
+        f"prefix={PREFIX_TOKENS}tok×{PREFIX_FAMILIES}fam "
+        f"n_req={PREFIX_N_REQ}/{DIS_N_REQ}"
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

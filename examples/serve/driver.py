@@ -59,18 +59,39 @@ def synthetic_trace(
     prompt_range=(6, 24),
     new_tokens_range=(4, 28),
     vocab: int = 512,
+    shared_prefix_tokens: int = 0,
+    prefix_families: int = 4,
 ) -> List[Request]:
     """Poisson arrivals with uniform prompt/generation lengths — the bench's
-    synthetic open-loop load (arrival times are offsets from trace start)."""
+    synthetic open-loop load (arrival times are offsets from trace start).
+
+    ``shared_prefix_tokens > 0`` switches to a PREFIX-HEAVY workload: the
+    trace draws ``prefix_families`` fixed prompt prefixes of that length
+    (system prompts / few-shot preambles), and each request samples its
+    family Zipf-style (probability ∝ 1/rank — a few templates dominate, a
+    long tail trickles, the shape RadixAttention exploits) before appending
+    its own uniform-random tail from ``prompt_range``."""
     rng = np.random.RandomState(seed)
+    families = [
+        list(rng.randint(1, vocab, shared_prefix_tokens))
+        for _ in range(prefix_families if shared_prefix_tokens > 0 else 0)
+    ]
+    if families:
+        weights = 1.0 / np.arange(1, len(families) + 1)
+        weights /= weights.sum()
     t = 0.0
     out = []
     for i in range(n_requests):
         t += float(rng.exponential(1.0 / rate_hz))
+        tail = list(rng.randint(1, vocab, rng.randint(*prompt_range)))
+        prefix = (
+            families[int(rng.choice(len(families), p=weights))]
+            if families else []
+        )
         out.append(
             Request(
                 rid=i,
-                prompt=list(rng.randint(1, vocab, rng.randint(*prompt_range))),
+                prompt=prefix + tail,
                 max_new_tokens=int(rng.randint(*new_tokens_range)),
                 arrival=t,
             )
@@ -127,14 +148,18 @@ def serve(
     flight_capacity: int = 64,
     fail_after_steps: Optional[int] = None,
     telemetry: Optional[ServingTelemetry] = None,
+    prefix_cache: bool = False,
 ) -> List[Request]:
     """Replay an open-loop trace through the continuous batcher; returns the
     finished requests. Any exception in the request loop auto-dumps the
     flight recorder to ``flight_path`` before propagating. Pass a
     :class:`ServingTelemetry` to collect per-request lifecycle records and
     latency histograms (its SLO policy, if any, dumps through the same
-    flight recorder on breach)."""
-    batcher = ContinuousBatcher(engine, telemetry=telemetry)
+    flight recorder on breach). ``prefix_cache=True`` turns on radix
+    prefix caching (shared prompt prefixes alias shared KV pages)."""
+    batcher = ContinuousBatcher(
+        engine, telemetry=telemetry, prefix_cache=prefix_cache
+    )
     recorder = FlightRecorder(
         flight_capacity, path=flight_path, auto_dump_on_rollback=False
     )
@@ -166,6 +191,13 @@ def main(argv=None) -> dict:
     ap.add_argument("--flight-path", default="flight.json")
     ap.add_argument("--fail-after-steps", type=int, default=None,
                     help="inject a request-loop crash (flight-dump demo)")
+    ap.add_argument("--shared-prefix-tokens", type=int, default=0,
+                    help="prefix-heavy workload: length of the shared "
+                         "prompt prefix each family reuses (0 = off)")
+    ap.add_argument("--prefix-families", type=int, default=4,
+                    help="number of Zipf-sampled shared-prefix families")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="serve with radix prefix caching on")
     args = ap.parse_args(argv)
 
     cfg = gpt.GPTConfig()
@@ -175,13 +207,18 @@ def main(argv=None) -> dict:
         EngineConfig(max_seq_len=64, page_size=8, num_pages=49,
                      batch_buckets=(4, 8), prefill_seq_buckets=(32, 64)),
     )
-    trace = synthetic_trace(args.requests, args.rate, seed=args.seed)
+    trace = synthetic_trace(
+        args.requests, args.rate, seed=args.seed,
+        shared_prefix_tokens=args.shared_prefix_tokens,
+        prefix_families=args.prefix_families,
+    )
     telemetry = ServingTelemetry()
     finished = serve(
         trace, engine,
         flight_path=args.flight_path,
         fail_after_steps=args.fail_after_steps,
         telemetry=telemetry,
+        prefix_cache=args.prefix_cache,
     )
     # histogram-backed report: p50/p99 carry the analytic error bound
     # instead of a raw-list sort, and throughput/goodput come pre-rolled
@@ -195,6 +232,7 @@ def main(argv=None) -> dict:
         "p50_ms": report["e2e_p50_ms"],
         "p99_ms": report["e2e_p99_ms"],
         "preemptions": report["preemptions"],
+        "prefix_hit_rate": report["prefix_hit_rate"],
         "compile_counts": monitor.compile_counts(),
     }
     print(stats)

@@ -131,3 +131,66 @@ class TestMonitorExport:
         finally:
             clear_probe_cache()
             reset_dispatch_counters()
+
+
+class TestBenchDiffFold:
+    """The CI drift hook bench.py runs at the end of every bench: compare
+    the fresh metric tree against the most recent BENCH_r*.json and fold the
+    verdict into detail — without ever failing the run it audits."""
+
+    @staticmethod
+    def _fold(tmp_path, result):
+        detail = result["detail"]
+        bench._fold_bench_diff(detail, result, root=str(tmp_path))
+        return detail["bench_drift"]
+
+    def test_no_baseline_degrades_to_note(self, tmp_path):
+        drift = self._fold(tmp_path, {"value": 1.0, "detail": {}})
+        assert drift["baseline"] is None
+        assert "no prior" in drift["note"]
+
+    def test_stable_run_passes(self, tmp_path):
+        import json
+
+        old = {"n": 4, "rc": 0,
+               "parsed": {"value": 100.0, "detail": {"ratio": 1.5}}}
+        (tmp_path / "BENCH_r04.json").write_text(json.dumps(old))
+        drift = self._fold(
+            tmp_path,
+            {"value": 101.0, "detail": {"ratio": 1.52}},
+        )
+        assert drift["baseline"] == "BENCH_r04.json"
+        assert drift["stable"] and drift["regressions_total"] == 0
+        assert drift["compared"] == 2
+
+    def test_drift_past_gate_is_flagged_not_fatal(self, tmp_path):
+        import json
+
+        old = {"parsed": {"value": 100.0, "detail": {"ratio": 1.5}}}
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(old))
+        drift = self._fold(
+            tmp_path,
+            {"value": 50.0, "detail": {"ratio": 1.5}},
+        )
+        assert not drift["stable"]
+        assert drift["regressions_total"] == 1
+        assert drift["regressions"][0]["key"] == "value"
+
+    def test_picks_highest_run_number(self, tmp_path):
+        import json
+
+        for n, v in ((2, 70.0), (10, 100.0)):  # r10 > r2 numerically
+            (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+                json.dumps({"parsed": {"value": v}}))
+        drift = self._fold(tmp_path, {"value": 100.0, "detail": {}})
+        assert drift["baseline"] == "BENCH_r10.json"
+        assert drift["stable"]
+
+    def test_unparsed_baseline_warns_and_passes(self, tmp_path):
+        import json
+
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps({"n": 1, "rc": 1, "parsed": None}))
+        drift = self._fold(tmp_path, {"value": 1.0, "detail": {}})
+        assert drift["baseline_unparsed"] and not drift["stable"]
+        assert drift["compared"] == 0

@@ -427,6 +427,75 @@ class TestDegenerateCarves:
         assert counts.get("psum", 0) > 0
 
 
+class TestDcnBucketKnob:
+    """``bucket_bytes_dcn``: the DCN leg re-buckets independently of ICI
+    (DCN wants fewer, bigger collectives). Regrouping an elementwise reduce
+    is bitwise-invisible — only the ledger's per-tier call count may move."""
+
+    @pytest.mark.parametrize("n_slices,slice_size",
+                             [(2, 4), (4, 2), (8, 1), (1, 8)])
+    @pytest.mark.parametrize("dcn_bytes", [512, 1 << 20])
+    def test_bitwise_parity_at_mixed_geometries(self, devices8, n_slices,
+                                                slice_size, dcn_bytes):
+        """Per-rank-distinct ragged payload, every carve (full, wide, tall,
+        both degenerates), DCN buckets both smaller and larger than the ICI
+        chunks: bits must match the flat chained psum exactly."""
+        mesh = make_two_level_mesh(n_slices, slice_size, devices=devices8)
+        x = jnp.asarray(
+            np.random.RandomState(3).randn(1000).astype(np.float32))
+
+        def body(a):
+            r = (jax.lax.axis_index(AX[0]) * slice_size
+                 + jax.lax.axis_index(AX[1]))
+            al = a * (1.0 + 0.125 * r.astype(a.dtype))
+            flat = bucketing.bucketed_psum(
+                al, AX, site="tdcn.flat", bucket_bytes=1024)
+            hier = bucketing.hierarchical_psum(
+                al, AX, site="tdcn.hier", bucket_bytes=1024,
+                bucket_bytes_dcn=dcn_bytes)
+            return flat, hier
+
+        flat, hier = _run(mesh, body, x, out_specs=(P(), P()))
+        np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+
+    def test_regrouping_moves_dcn_call_count_not_bytes(self, two_level_mesh):
+        """A large DCN bucket folds the per-ICI-bucket psums into ONE DCN
+        collective; the DCN payload bytes stay exactly 1/slice_size of the
+        flat payload either way."""
+        x = jnp.zeros((1000,), jnp.float32)
+
+        def tier(site, **kw):
+            mon_comms.reset_comms_ledger()
+            jax.make_jaxpr(functools.partial(
+                shard_map, mesh=two_level_mesh, in_specs=(P(),),
+                out_specs=P())(
+                    lambda a: bucketing.hierarchical_psum(
+                        a, AX, site=site, bucket_bytes=1024, **kw)))(x)
+            row = next(r for r in mon_comms.comms_summary()
+                       if r["subsystem"] == site.split(".")[0])
+            return row["by_tier"]["dcn"]
+
+        follow = tier("tdf.follow")  # DCN follows the 4 ICI buckets
+        merged = tier("tdm.merged", bucket_bytes_dcn=1 << 20)
+        assert follow["calls"] == 4
+        assert merged["calls"] == 1
+        assert merged["bytes"] == follow["bytes"]
+
+    def test_bucketed_reduce_threads_and_validates(self, two_level_mesh):
+        with pytest.raises(ValueError):  # flat policy can't size a DCN tier
+            bucketing.BucketedReduce(bucket_bytes_dcn=1 << 20)
+        pol = bucketing.BucketedReduce(
+            axis_name=AX, hierarchical=True, bucket_bytes=1024,
+            bucket_bytes_dcn=1 << 20)
+        x = jnp.asarray(
+            np.random.RandomState(5).randn(300).astype(np.float32))
+        out = _run(two_level_mesh,
+                   lambda a: pol.psum(a, site="tdp.psum"), x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(x) * (N_SLICES * SLICE_SIZE),
+            rtol=1e-6)
+
+
 class TestValidation:
     def test_hierarchical_axes_normalization(self):
         assert hierarchical_axes("data") is None

@@ -1,0 +1,581 @@
+"""Production-serving rungs (ISSUE 19 acceptance contracts):
+
+* **fp8 KV pages**: quantized write/gather round-trips inside the analytic
+  ``kv_dequant_error_bound``; page scales freeze at first write (later
+  tokens saturate, never requantize); the e4m3 null page dequantizes to
+  exactly 0 so padding stays harmless; an e4m3-cache engine's greedy
+  trajectory matches fp32 and its logit deviation sits inside
+  ``kv_logit_error_bound``; the layout's page bytes shrink ≥ 1.8×.
+* **refcounted PageAllocator**: alloc→1, ref extends live lineages only,
+  free decrements and recycles at zero, exhaustion stays all-or-nothing,
+  double-free/stale-alias raise.
+* **radix prefix cache**: lookup takes refs on the caller's behalf, insert
+  adopts full pages only, eviction is LRU-leaf-only and never recycles a
+  page readers still hold; through the batcher, prefix-cache-ON token
+  streams are byte-identical to OFF, shared pages carry refcount > 1 while
+  aliased (writer isolation is structural: first write lands on a fresh
+  page), and the whole-prompt COW path re-derives only the tail page.
+* **disaggregation**: the decode-priority scheduler with split bucket sets
+  produces byte-identical streams to unified continuous batching, keeps the
+  compiled signature set closed, and returns every page.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beforeholiday_tpu.infer import (
+    ContinuousBatcher,
+    DisaggregatedBatcher,
+    EngineConfig,
+    InferenceEngine,
+    PageAllocator,
+    PagedLayout,
+    RadixCache,
+    Request,
+    ServingTelemetry,
+    alloc_cache,
+    gather_pages_quantized,
+    kv_dequant_error_bound,
+    kv_logit_error_bound,
+    pages_for,
+    write_prefill_quantized,
+    write_token_quantized,
+)
+from beforeholiday_tpu.infer.kvcache import KV_SCALE_MARGIN
+from beforeholiday_tpu.testing import gpt
+
+pytestmark = pytest.mark.infer
+
+TINY = dict(vocab_size=64, seq_len=64, d_model=32, n_heads=2, n_layers=2,
+            dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = gpt.GPTConfig(**TINY)
+    return cfg, gpt.init(jax.random.PRNGKey(0), cfg)
+
+
+@pytest.fixture(scope="module")
+def fp8_engine(tiny_model):
+    cfg, params = tiny_model
+    ecfg = EngineConfig(
+        max_seq_len=32, page_size=8, num_pages=17, batch_buckets=(2,),
+        prefill_seq_buckets=(8, 16), entry_prefix="serving_fp8",
+        cache_dtype="e4m3",
+    )
+    return InferenceEngine(params, cfg, ecfg)
+
+
+@pytest.fixture(scope="module")
+def fp32_engine(tiny_model):
+    cfg, params = tiny_model
+    ecfg = EngineConfig(
+        max_seq_len=32, page_size=8, num_pages=17, batch_buckets=(2,),
+        prefill_seq_buckets=(8, 16), entry_prefix="serving_f32",
+    )
+    return InferenceEngine(params, cfg, ecfg)
+
+
+def _greedy_reference(params, cfg, prompt, n_new):
+    seq = list(prompt)
+    for _ in range(n_new):
+        logits = gpt.forward(params, jnp.asarray([seq], jnp.int32), cfg)
+        seq.append(int(np.argmax(np.asarray(logits[0, len(seq) - 1]))))
+    return seq[len(prompt):]
+
+
+def _drive(engine, prompts, n_new):
+    """Prefill + incremental greedy decode through the engine's host API."""
+    alloc = PageAllocator(engine.cfg.num_pages)
+    ps = engine.cfg.page_size
+    tables = [alloc.alloc(pages_for(len(p), ps)) for p in prompts]
+    outs = [[] for _ in prompts]
+    toks = engine.prefill(prompts, tables).tolist()
+    lens = [len(p) for p in prompts]
+    for i, t in enumerate(toks):
+        outs[i].append(t)
+    for _ in range(n_new - 1):
+        for i in range(len(prompts)):
+            while len(tables[i]) * ps <= lens[i]:
+                tables[i] += alloc.alloc(1)
+        toks = engine.decode(toks, lens, tables).tolist()
+        for i, t in enumerate(toks):
+            outs[i].append(t)
+            lens[i] += 1
+    return outs
+
+
+# ------------------------------------------------------------- fp8 KV pages
+
+
+class TestFp8Pages:
+    LAYOUT = dict(n_layers=1, n_pages=5, page_size=4, kv_dim=8)
+
+    def _pool(self, dtype_name="e4m3"):
+        lay = PagedLayout(dtype_name=dtype_name, **self.LAYOUT)
+        cache = alloc_cache(lay)
+        return lay, cache.k[0], cache.k_scale[0]
+
+    def test_prefill_roundtrip_within_dequant_bound(self):
+        _, pages, scales = self._pool()
+        rng = np.random.RandomState(0)
+        vals = jnp.asarray(rng.randn(2, 8, 8).astype(np.float32)) * 3.0
+        table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+        pages, scales = write_prefill_quantized(pages, scales, table, vals)
+        back = gather_pages_quantized(pages, scales, table)
+        err = np.abs(np.asarray(back) - np.asarray(vals))
+        per_page = np.asarray(scales)[np.asarray(table)]  # (B, slots)
+        s = np.repeat(per_page, 4, axis=1)[:, :, None]  # broadcast to tokens
+        bound = np.asarray(kv_dequant_error_bound(vals, jnp.asarray(s)))
+        assert np.all(err <= bound), float(np.max(err - bound))
+        assert float(np.max(err)) > 0.0  # it really did quantize
+
+    def test_scale_freezes_at_page_open_then_saturates(self):
+        """First token on a page fixes the scale from its own amax (with
+        margin headroom); a bigger token later on the SAME page must clip at
+        the frozen scale, not rescale the page."""
+        _, pages, scales = self._pool()
+        table = jnp.asarray([[1, 0]], jnp.int32)
+        small = jnp.full((1, 8), 1.0, jnp.float32)
+        big = jnp.full((1, 8), 100.0, jnp.float32)
+        pages, scales = write_token_quantized(
+            pages, scales, table, jnp.asarray([0]), small)
+        frozen = float(scales[1])
+        assert frozen == pytest.approx(448.0 / KV_SCALE_MARGIN)
+        pages, scales = write_token_quantized(
+            pages, scales, table, jnp.asarray([1]), big)
+        assert float(scales[1]) == frozen  # no requantization
+        back = gather_pages_quantized(pages, scales, table)
+        # in-headroom value round-trips tightly; outlier saturated at the
+        # frozen scale's ceiling = E4M3_MAX / scale = amax * margin
+        assert float(back[0, 0, 0]) == pytest.approx(1.0, rel=0.1)
+        assert float(back[0, 1, 0]) == pytest.approx(
+            1.0 * KV_SCALE_MARGIN, rel=0.1)
+        clip_err = abs(float(back[0, 1, 0]) - 100.0)
+        bound = kv_dequant_error_bound(big[0], scales[1])
+        assert clip_err <= float(bound[0])
+
+    def test_null_page_dequantizes_to_zero(self):
+        _, pages, scales = self._pool()
+        table = jnp.zeros((1, 2), jnp.int32)  # all slots -> null page
+        back = gather_pages_quantized(pages, scales, table)
+        assert float(jnp.max(jnp.abs(back))) == 0.0
+
+    def test_quantized_layout_validation_and_bytes(self):
+        lay8 = PagedLayout(dtype_name="e4m3", **self.LAYOUT)
+        lay32 = PagedLayout(dtype_name="float32", **self.LAYOUT)
+        assert lay8.quantized and not lay32.quantized
+        # the capacity claim at layout level: >= 1.8x sequences per byte
+        assert lay32.page_bytes / lay8.page_bytes >= 1.8
+        with pytest.raises((ValueError, TypeError)):
+            PagedLayout(dtype_name="not_a_dtype", **self.LAYOUT)
+
+    def test_fp8_engine_greedy_parity_and_logit_bound(
+            self, tiny_model, fp32_engine, fp8_engine):
+        cfg, params = tiny_model
+        prompts = [[5, 9, 2, 7, 1, 3], [11, 4, 8]]
+        n_new = 8
+        fp32_engine.reset_cache()
+        fp8_engine.reset_cache()
+        ref = _drive(fp32_engine, prompts, n_new)
+        got = _drive(fp8_engine, prompts, n_new)
+        assert got == ref
+        for i, p in enumerate(prompts):
+            assert got[i] == _greedy_reference(params, cfg, p, n_new)
+        # measured logit deviation inside the exported envelope
+        fp32_engine.reset_cache()
+        fp8_engine.reset_cache()
+        a32, a8 = PageAllocator(17), PageAllocator(17)
+        t32, t8 = [a32.alloc(1)], [a8.alloc(1)]
+        fp32_engine.prefill([prompts[0][:5]], t32)
+        fp8_engine.prefill([prompts[0][:5]], t8)
+        l32 = fp32_engine.decode_logits([7], [5], t32)
+        l8 = fp8_engine.decode_logits([7], [5], t8)
+        dev = float(np.max(np.abs(np.asarray(l32) - np.asarray(l8))))
+        bound = kv_logit_error_bound(
+            0, n_layers=TINY["n_layers"],
+            logit_ceiling=float(np.max(np.abs(np.asarray(l32)))),
+        )
+        assert 0.0 < dev <= bound
+
+    def test_fp8_padding_rows_cannot_perturb_live_rows(self, fp8_engine):
+        """The null-page contract survives quantization: a live row's logits
+        are identical with a padded neighbor vs a live one."""
+        fp8_engine.reset_cache()
+        alloc = PageAllocator(fp8_engine.cfg.num_pages)
+        p0, p1 = [3, 1, 4, 1], [9, 2, 6, 5]
+        t0, t1 = alloc.alloc(1), alloc.alloc(1)
+        fp8_engine.prefill([p0, p1], [t0, t1])
+        solo = fp8_engine.decode_logits([7], [len(p0)], [t0])
+        fp8_engine.reset_cache()
+        alloc = PageAllocator(fp8_engine.cfg.num_pages)
+        t0, t1 = alloc.alloc(1), alloc.alloc(1)
+        fp8_engine.prefill([p0, p1], [t0, t1])
+        both = fp8_engine.decode_logits([7, 8], [len(p0), len(p1)], [t0, t1])
+        np.testing.assert_array_equal(np.asarray(solo[0]), np.asarray(both[0]))
+
+    def test_logit_bound_shape(self):
+        b0 = kv_logit_error_bound(0, n_layers=2, logit_ceiling=10.0)
+        b5 = kv_logit_error_bound(5, n_layers=2, logit_ceiling=10.0)
+        assert 0.0 < b0 < b5  # grows with decode depth
+        assert kv_logit_error_bound(
+            0, n_layers=4, logit_ceiling=10.0) > b0  # and with layers
+        with pytest.raises(ValueError):
+            kv_logit_error_bound(0, n_layers=0, logit_ceiling=10.0)
+
+
+# --------------------------------------------------- refcounted allocator
+
+
+class TestRefcountedAllocator:
+    def test_alloc_ref_free_lifecycle(self):
+        a = PageAllocator(6)
+        (p,) = a.alloc(1)
+        assert a.refcount(p) == 1 and a.live_pages == 1
+        a.ref([p])
+        assert a.refcount(p) == 2
+        a.free([p])
+        assert a.refcount(p) == 1 and a.available == 4  # still live
+        a.free([p])
+        assert a.refcount(p) == 0 and a.available == 5  # recycled
+
+    def test_exhaustion_all_or_nothing_with_refs_held(self):
+        a = PageAllocator(4)
+        got = a.alloc(2)
+        a.ref(got)  # a second holder pins them
+        assert a.alloc(2) is None  # only 1 page free: nothing consumed
+        assert a.available == 1
+        a.free(got)
+        assert a.alloc(2) is None  # refs still pin the pages
+        a.free(got)
+        assert a.alloc(3) is not None
+
+    def test_double_free_and_foreign_free_raise(self):
+        a = PageAllocator(4)
+        got = a.alloc(1)
+        a.free(got)
+        with pytest.raises(ValueError):
+            a.free(got)
+        with pytest.raises(ValueError):
+            a.free([0])  # the null page is never allocatable
+
+    def test_stale_alias_ref_raises(self):
+        """A ref may only extend a LIVE lineage — refing a recycled page is
+        the use-after-free of page caching and must be loud."""
+        a = PageAllocator(4)
+        got = a.alloc(1)
+        a.free(got)
+        with pytest.raises(ValueError):
+            a.ref(got)
+        # all-or-nothing: a mixed ref ask must not half-apply
+        live = a.alloc(1)
+        with pytest.raises(ValueError):
+            a.ref(live + got)
+        assert a.refcount(live[0]) == 1
+
+
+# ------------------------------------------------------------- radix cache
+
+
+class TestRadixCache:
+    def _mk(self, n_pages=10, ps=4):
+        a = PageAllocator(n_pages)
+        return a, RadixCache(a, ps)
+
+    def test_insert_then_lookup_takes_caller_refs(self):
+        a, rc = self._mk()
+        pages = a.alloc(2)
+        adopted = rc.insert([1, 2, 3, 4, 5, 6, 7, 8, 9], pages)  # 2 full pages
+        assert adopted == 2 and rc.pages_held == 2
+        assert all(a.refcount(p) == 2 for p in pages)  # owner + tree
+        hit, m = rc.lookup([1, 2, 3, 4, 5, 6, 7, 8, 42])
+        assert hit == pages and m == 8
+        assert all(a.refcount(p) == 3 for p in pages)  # + the lookup
+        a.free(hit)
+
+    def test_partial_and_miss_lookups(self):
+        a, rc = self._mk()
+        pages = a.alloc(2)
+        rc.insert([1, 2, 3, 4, 5, 6, 7, 8], pages)
+        hit, m = rc.lookup([1, 2, 3, 4, 9, 9, 9, 9])  # diverges page 2
+        assert hit == pages[:1] and m == 4
+        a.free(hit)
+        hit, m = rc.lookup([9, 9, 9, 9])
+        assert hit == [] and m == 0
+        hit, m = rc.lookup([1, 2, 3])  # shorter than a page: no full chunk
+        assert hit == [] and m == 0
+        assert 0.0 < rc.hit_rate < 1.0
+
+    def test_insert_keeps_existing_nodes_pages(self):
+        """Re-inserting a shared prefix from a different owner adopts only
+        the NEW chunks — resident chunks keep their first page (same bytes
+        by construction), so aliases keep piling on one physical page."""
+        a, rc = self._mk()
+        first = a.alloc(2)
+        rc.insert([1, 2, 3, 4, 5, 6, 7, 8], first)
+        second = a.alloc(2)
+        adopted = rc.insert([1, 2, 3, 4, 9, 9, 9, 9], second)
+        assert adopted == 1  # only the diverging page 2 chunk
+        hit, _ = rc.lookup([1, 2, 3, 4])
+        assert hit == first[:1]  # the resident page, not second[0]
+        a.free(hit)
+        assert a.refcount(second[0]) == 1  # tree never took it
+
+    def test_evict_is_lru_leaf_only_and_respects_readers(self):
+        a, rc = self._mk(n_pages=12)
+        deep = a.alloc(2)
+        rc.insert([1, 2, 3, 4, 5, 6, 7, 8], deep)  # parent + child
+        solo = a.alloc(1)
+        rc.insert([7, 7, 7, 7], solo)
+        a.free(deep + solo)  # owners drop out; tree refs keep pages live
+        # reader pins the deep child
+        hit, _ = rc.lookup([1, 2, 3, 4, 5, 6, 7, 8])
+        # LRU order among LEAVES: solo is older than the just-touched deep
+        # child; the deep PARENT is interior and must not be evicted first
+        assert rc.evict(1) == 1
+        assert a.refcount(solo[0]) == 0  # tree ref was the last holder
+        assert rc.evict(1) == 1  # now the deep child leaf
+        assert a.refcount(deep[1]) == 1  # reader still holds it
+        assert rc.pages_held == 1  # the parent, now a leaf
+        a.free(hit)
+
+    def test_clear_releases_everything(self):
+        a, rc = self._mk()
+        pages = a.alloc(3)
+        rc.insert([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12], pages)
+        a.free(pages)
+        assert a.available == 9 - 3
+        assert rc.clear() == 3
+        assert a.available == 9 and rc.pages_held == 0
+
+
+# ---------------------------------------------- prefix caching, end to end
+
+
+SHARED = [7, 7, 3, 9, 1, 2, 4, 8]  # two full pages at page_size 4
+
+
+def _family(n, shared=SHARED):
+    reqs = []
+    for i in range(n):
+        tail = [(i * 3 + j) % 60 for j in range(i % 3)]
+        reqs.append(Request(rid=i, prompt=list(shared) + tail,
+                            max_new_tokens=4 + i % 3))
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def radix_engine(tiny_model):
+    cfg, params = tiny_model
+    ecfg = EngineConfig(
+        max_seq_len=32, page_size=4, num_pages=33, batch_buckets=(2, 4),
+        prefill_seq_buckets=(8, 16, 32), entry_prefix="serving_radix",
+    )
+    return InferenceEngine(params, cfg, ecfg)
+
+
+class TestPrefixCacheBatching:
+    def test_streams_byte_identical_to_uncached(self, radix_engine):
+        radix_engine.reset_cache()
+        off = ContinuousBatcher(radix_engine, now_fn=lambda: 1.0)
+        for r in _family(6):
+            off.submit(r)
+        ref = {r.rid: r.out for r in off.run(max_steps=300)}
+        radix_engine.reset_cache()
+        on = ContinuousBatcher(radix_engine, now_fn=lambda: 1.0,
+                               prefix_cache=True)
+        for r in _family(6):
+            on.submit(r)
+        got = {r.rid: r.out for r in on.run(max_steps=300)}
+        assert got == ref
+        assert on.radix.hit_tokens > 0  # later requests really did alias
+        # pool accounting: only the tree's refs remain, and they all release
+        on.radix.clear()
+        assert on.allocator.available == radix_engine.cfg.num_pages - 1
+
+    def test_aliased_pages_carry_shared_refcounts(self, radix_engine):
+        """While an extend-admitted request is active, its matched pages are
+        held by tree + owner + alias (refcount >= 2) — the assertion surface
+        for writer isolation (writers only touch refcount-1 fresh pages)."""
+        radix_engine.reset_cache()
+        bat = ContinuousBatcher(radix_engine, now_fn=lambda: 1.0,
+                                prefix_cache=True)
+        first = Request(rid=0, prompt=list(SHARED), max_new_tokens=2)
+        bat.submit(first)
+        bat.run(max_steps=100)
+        # same prefix + a 5-token tail: full pages alias, tail prefills fresh
+        nxt = Request(rid=1, prompt=list(SHARED) + [9, 9, 9, 9, 9],
+                      max_new_tokens=3)
+        bat.submit(nxt)
+        bat.step()  # admits via extend AND runs one decode tick
+        assert nxt in bat.active and nxt.cached == len(SHARED) + 1
+        shared_pages = nxt.pages[:2]
+        fresh_pages = nxt.pages[2:]
+        assert all(bat.allocator.refcount(p) >= 2 for p in shared_pages)
+        assert all(bat.allocator.refcount(p) == 1 for p in fresh_pages)
+        # the next write position sits on a fresh page, never a shared one
+        assert nxt.pages[nxt.cached // 4] in fresh_pages
+        fin = bat.run(max_steps=200)
+        assert {r.rid for r in fin} == {0, 1}
+
+    def test_whole_prompt_hit_takes_cow_tail_copy(self, tiny_model,
+                                                  radix_engine):
+        cfg, params = tiny_model
+        radix_engine.reset_cache()
+        bat = ContinuousBatcher(radix_engine, now_fn=lambda: 1.0,
+                                prefix_cache=True)
+        bat.submit(Request(rid=0, prompt=list(SHARED), max_new_tokens=3))
+        bat.run(max_steps=100)
+        rep = Request(rid=1, prompt=list(SHARED), max_new_tokens=3)
+        bat.submit(rep)
+        bat.step()
+        # COW admission: cached = n_prompt - 1 (only the last token re-runs)
+        assert rep.cached >= len(SHARED) - 1
+        fin = {r.rid: r.out for r in bat.run(max_steps=200)}
+        ref = _greedy_reference(params, cfg, SHARED, 3)
+        assert fin[0] == ref and fin[1] == ref
+
+    def test_replays_after_preemption_skip_extend(self, tiny_model):
+        """Preempted requests re-enter through FULL prefill (their ``out``
+        is part of the replay sequence; decode-extend is for virgin
+        prompts) — and the trajectory stays byte-identical."""
+        cfg, params = tiny_model
+        ecfg = EngineConfig(
+            max_seq_len=32, page_size=4, num_pages=10, batch_buckets=(2, 4),
+            prefill_seq_buckets=(8, 16, 32),
+            entry_prefix="serving_radix_preempt",
+        )
+        eng = InferenceEngine(params, cfg, ecfg)  # 9 usable pages: famine
+        specs = [([3, 1, 4, 2], 10), ([3, 1, 4, 2], 10), ([5, 8, 1, 9], 8)]
+        bat = ContinuousBatcher(eng, now_fn=lambda: 1.0, prefix_cache=True)
+        for i, (p, n) in enumerate(specs):
+            bat.submit(Request(rid=i, prompt=list(p), max_new_tokens=n))
+        fin = {r.rid: r for r in bat.run(max_steps=500)}
+        for i, (p, n) in enumerate(specs):
+            assert fin[i].out == _greedy_reference(params, cfg, p, n)
+
+    def test_prefix_telemetry_keys(self, radix_engine):
+        radix_engine.reset_cache()
+        tel = ServingTelemetry()
+        bat = ContinuousBatcher(radix_engine, now_fn=lambda: 1.0,
+                                prefix_cache=True, telemetry=tel)
+        for r in _family(6):
+            bat.submit(r)
+        bat.run(max_steps=300)
+        rep = tel.serving_report()
+        assert rep["prefix_lookups"] > 0
+        assert rep["prefix_hits"] > 0
+        assert 0.0 < rep["prefix_hit_rate"] <= 1.0
+        assert rep["prefix_hit_tokens"] > 0
+        # delivered tokens must count each request once, extends included
+        assert rep["tokens_delivered"] == sum(4 + i % 3 for i in range(6))
+
+
+# ---------------------------------------------------------- disaggregation
+
+
+SPECS = [([3, 1, 4], 6), ([1, 5], 2), ([9, 2, 6, 5, 3], 8),
+         ([5, 8], 1), ([7, 7, 7], 5), ([2, 4, 6, 8], 4)]
+
+
+def _requests():
+    return [Request(rid=i, prompt=list(p), max_new_tokens=n)
+            for i, (p, n) in enumerate(SPECS)]
+
+
+class TestDisaggregation:
+    def test_config_split_bucket_sets(self):
+        cfg = EngineConfig(
+            max_seq_len=32, page_size=8, num_pages=17, batch_buckets=(2, 4),
+            prefill_seq_buckets=(8, 16), decode_batch_buckets=(8,),
+        )
+        assert cfg.max_prefill_batch == 4 and cfg.max_batch == 8
+        assert cfg.decode_buckets == (8,)
+        # backcompat: None means one shared bucket set
+        uni = EngineConfig(
+            max_seq_len=32, page_size=8, num_pages=17, batch_buckets=(2, 4),
+            prefill_seq_buckets=(8, 16),
+        )
+        assert uni.decode_buckets == (2, 4) and uni.max_batch == 4
+        with pytest.raises(ValueError):  # must ascend
+            EngineConfig(
+                max_seq_len=32, page_size=8, batch_buckets=(2,),
+                prefill_seq_buckets=(8,), decode_batch_buckets=(8, 4),
+            )
+
+    def test_streams_match_unified_and_signatures_closed(self, tiny_model):
+        cfg, params = tiny_model
+        uni_cfg = EngineConfig(
+            max_seq_len=32, page_size=8, num_pages=33, batch_buckets=(8,),
+            prefill_seq_buckets=(8, 16, 32), entry_prefix="serving_uni",
+        )
+        dis_cfg = EngineConfig(
+            max_seq_len=32, page_size=8, num_pages=33, batch_buckets=(2, 8),
+            prefill_seq_buckets=(8, 16, 32), decode_batch_buckets=(8,),
+            entry_prefix="serving_dis",
+        )
+        uni = ContinuousBatcher(
+            InferenceEngine(params, cfg, uni_cfg), now_fn=lambda: 1.0)
+        for r in _requests():
+            uni.submit(r)
+        ref = {r.rid: r.out for r in uni.run(max_steps=300)}
+        eng = InferenceEngine(params, cfg, dis_cfg)
+        dis = DisaggregatedBatcher(eng, now_fn=lambda: 1.0)
+        for r in _requests():
+            dis.submit(r)
+        got = {r.rid: r.out for r in dis.run(max_steps=300)}
+        assert got == ref
+        assert dis.allocator.available == dis_cfg.num_pages - 1
+        assert eng.compiled_signatures <= dis_cfg.declared_signatures
+
+    def test_prefill_respects_small_buckets_with_backpressure(self,
+                                                              tiny_model):
+        cfg, params = tiny_model
+        dis_cfg = EngineConfig(
+            max_seq_len=32, page_size=8, num_pages=33, batch_buckets=(2,),
+            prefill_seq_buckets=(8,), decode_batch_buckets=(4,),
+            entry_prefix="serving_dis_bp",
+        )
+        eng = InferenceEngine(params, cfg, dis_cfg)
+        dis = DisaggregatedBatcher(eng, now_fn=lambda: 1.0)
+        for i in range(6):
+            dis.submit(Request(rid=i, prompt=[3 + i, 1, 4],
+                               max_new_tokens=6))
+        dis.step()
+        # one prefill tick admits at most the prefill bucket (2), and the
+        # active set can never exceed decode capacity (4)
+        assert len(dis.active) + len(dis.handoff) <= 2
+        for _ in range(40):
+            dis.step()
+            assert len(dis.active) <= 4
+            if dis.idle:
+                break
+        assert dis.idle
+        fin = {r.rid: r.out for r in dis.finished}
+        for i in range(6):
+            assert fin[i] == _greedy_reference(
+                params, cfg, [3 + i, 1, 4], 6)
+
+    def test_disagg_composes_with_prefix_cache(self, tiny_model):
+        cfg, params = tiny_model
+        dis_cfg = EngineConfig(
+            max_seq_len=32, page_size=4, num_pages=33, batch_buckets=(2, 4),
+            prefill_seq_buckets=(8, 16, 32), decode_batch_buckets=(4,),
+            entry_prefix="serving_dis_radix",
+        )
+        eng = InferenceEngine(params, cfg, dis_cfg)
+        bat = DisaggregatedBatcher(eng, now_fn=lambda: 1.0,
+                                   prefix_cache=True)
+        for r in _family(6):
+            bat.submit(r)
+        got = {r.rid: r.out for r in bat.run(max_steps=400)}
+        eng.reset_cache()
+        ref_bat = DisaggregatedBatcher(eng, now_fn=lambda: 1.0)
+        for r in _family(6):
+            ref_bat.submit(r)
+        ref = {r.rid: r.out for r in ref_bat.run(max_steps=400)}
+        assert got == ref
+        assert bat.radix.hit_tokens > 0
