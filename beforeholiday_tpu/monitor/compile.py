@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import functools
 import threading
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -176,12 +176,22 @@ def compile_summary() -> List[Dict[str, object]]:
     ]
 
 
-def reset_compile_counts() -> None:
-    """Forget all entries and re-arm their recompile warnings. Counting
+def reset_compile_counts(entry: Optional[str] = None) -> None:
+    """Forget tracked entries and re-arm their recompile warnings. Counting
     restarts at the next call — an already-cached executable re-counts as
-    one signature but does NOT recompile on the device."""
+    one signature but does NOT recompile on the device.
+
+    With ``entry``, the reset is SCOPED: only that entry's signature set,
+    call counter, and armed warning are cleared, every other entry keeps
+    counting. The autotuner resets its own ``tune.trial<N>`` scope between
+    trials this way — a global reset would silently zero the training
+    step's recompile evidence and disarm warnings the user still wants."""
     with _LOCK:
-        entries = list(_ENTRIES)
-        _ENTRIES.clear()
-    for name in entries:
+        if entry is not None:
+            _ENTRIES.pop(entry, None)
+            names = [entry]
+        else:
+            names = list(_ENTRIES)
+            _ENTRIES.clear()
+    for name in names:
         reset_warn_once((_WARN_PREFIX, name))

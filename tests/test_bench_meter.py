@@ -194,3 +194,149 @@ class TestBenchDiffFold:
         drift = self._fold(tmp_path, {"value": 1.0, "detail": {}})
         assert drift["baseline_unparsed"] and not drift["stable"]
         assert drift["compared"] == 0
+
+
+class TestStagesRegistry:
+    """``--only <stage>`` needs a complete registry: every subprocess stage
+    bench.py runs in main() must be individually addressable."""
+
+    def test_registry_contents(self):
+        assert set(bench.STAGES) == {
+            "pp_overhead", "comms_overhead", "remat_sweep", "overlap_skew",
+            "overlap_engine", "zero3", "multislice", "elastic", "chaos",
+            "moe", "telemetry", "quantized", "collective_matmul", "infer",
+            "serving", "autotune",
+        }
+        for name, fn in bench.STAGES.items():
+            assert callable(fn), name
+            assert fn.__name__ == f"bench_{name}", name
+
+    def test_run_only_smoke(self, monkeypatch, capsys):
+        import json
+
+        def bench_fake():
+            return {"metric": 1.25}
+
+        monkeypatch.setitem(bench.STAGES, "autotune", bench_fake)
+        rc = bench.run_only("autotune")
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0
+        assert out["stage"] == "autotune"
+        assert out["result"] == {"metric": 1.25}
+
+    def test_run_only_folds_stage_error(self, monkeypatch, capsys):
+        import json
+
+        def bench_fake():
+            raise RuntimeError("boom")
+
+        monkeypatch.setitem(bench.STAGES, "autotune", bench_fake)
+        rc = bench.run_only("autotune")
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 1
+        assert out["result"] is None
+        assert "RuntimeError: boom" in out["detail"]["bench_fake_error"]
+
+    def test_run_only_unknown_stage(self, capsys):
+        import json
+
+        rc = bench.run_only("nope")
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 2
+        assert "unknown stage" in out["error"]
+        assert out["stages"] == sorted(bench.STAGES)
+
+
+class TestStrictDrift:
+    """``--strict-drift`` promotes the folded drift verdict to the exit
+    code — but ONLY when a baseline actually existed."""
+
+    def test_no_drift_audit_not_fatal(self):
+        assert not bench._drift_fatal({})
+
+    def test_missing_baseline_not_fatal(self):
+        assert not bench._drift_fatal(
+            {"bench_drift": {"baseline": None, "note": "no prior run"}})
+
+    def test_audit_error_not_fatal(self):
+        assert not bench._drift_fatal(
+            {"bench_drift": {"error": "ValueError: ..."}})
+
+    def test_stable_baseline_not_fatal(self):
+        assert not bench._drift_fatal(
+            {"bench_drift": {"baseline": "BENCH_r04.json", "stable": True}})
+
+    def test_regression_against_baseline_is_fatal(self):
+        assert bench._drift_fatal(
+            {"bench_drift": {"baseline": "BENCH_r04.json", "stable": False}})
+
+    def test_main_accepts_the_flag(self):
+        import inspect
+
+        assert "strict_drift" in inspect.signature(bench.main).parameters
+
+
+class TestBenchDiffKeysFilter:
+    """``bench_diff --keys`` restricts the gate to dotted paths containing
+    one of the given substrings — drill into one stage's metrics without
+    the rest of the tree vetoing or passing the run."""
+
+    @staticmethod
+    def _bd():
+        import importlib.util
+        import pathlib
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_diff_keys",
+            pathlib.Path(bench.__file__).parent / "tools" / "bench_diff.py",
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_keys_isolate_the_regression(self):
+        bd = self._bd()
+        old = {"parsed": {"detail": {"tuned_vs_default_step": 0.68,
+                                     "gpt_o5_step_ms": 100.0}}}
+        new = {"parsed": {"detail": {"tuned_vs_default_step": 0.69,
+                                     "gpt_o5_step_ms": 150.0}}}
+        full = bd.diff_runs(old, new, tol=0.10)
+        assert {r["key"] for r in full["regressions"]} == {
+            "detail.gpt_o5_step_ms"}
+        only_tuned = bd.diff_runs(old, new, tol=0.10, keys=["tuned_vs"])
+        assert only_tuned["compared"] == 1
+        assert only_tuned["regressions"] == []
+        only_gpt = bd.diff_runs(old, new, tol=0.10, keys=["gpt_o5"])
+        assert only_gpt["compared"] == 1
+        assert len(only_gpt["regressions"]) == 1
+
+    def test_keys_filter_applies_to_added_and_removed(self):
+        bd = self._bd()
+        old = {"parsed": {"a_old_only": 1.0, "b_shared": 2.0}}
+        new = {"parsed": {"a_new_only": 1.0, "b_shared": 2.0}}
+        res = bd.diff_runs(old, new, tol=0.10, keys=["b_"])
+        assert res["added"] == [] and res["removed"] == []
+        assert res["compared"] == 1
+
+    def test_cli_keys_and_exit_codes(self, tmp_path):
+        import json
+        import subprocess
+
+        tool = os.path.join(os.path.dirname(bench.__file__),
+                            "tools", "bench_diff.py")
+        old = tmp_path / "old.json"
+        new = tmp_path / "new.json"
+        old.write_text(json.dumps(
+            {"parsed": {"stable_key": 1.0, "moved_key": 100.0}}))
+        new.write_text(json.dumps(
+            {"parsed": {"stable_key": 1.0, "moved_key": 200.0}}))
+        full = subprocess.run(
+            [sys.executable, tool, str(old), str(new)],
+            capture_output=True, text=True)
+        assert full.returncode == 1
+        assert "DRIFT moved_key" in full.stdout
+        filtered = subprocess.run(
+            [sys.executable, tool, str(old), str(new), "--keys", "stable"],
+            capture_output=True, text=True)
+        assert filtered.returncode == 0, filtered.stdout + filtered.stderr
+        assert "1 keys compared" in filtered.stdout

@@ -1,0 +1,54 @@
+"""The deferred on-chip rungs must be registered and skip cleanly off-TPU.
+
+ROADMAP item 2 deferred four measurements to real hardware: the O6 GPT MFU
+rung, the O6-vs-O5 step ratio, the S=8192 flash backward, and the
+collective-matmul overlap win. This suite pins the CPU-container half of
+that contract: all four rungs exist in ``tpu_checks.RUNGS``, each is
+callable with no arguments, and on a CPU backend each returns a
+``{"skipped": reason}`` dict WITHOUT touching the device — so the next
+``python -m beforeholiday_tpu.testing.tpu_checks`` run on a real chip
+measures them with no further wiring.
+"""
+
+import jax
+import pytest
+
+from beforeholiday_tpu.testing import tpu_checks
+
+EXPECTED = {
+    "gpt_o6_mfu",
+    "o6_vs_o5_step",
+    "flash_bwd_s8192",
+    "collective_matmul_overlap",
+}
+
+
+def test_all_deferred_rungs_are_registered():
+    assert EXPECTED <= set(tpu_checks.RUNGS)
+    for name, fn in tpu_checks.RUNGS.items():
+        assert callable(fn)
+        assert fn.__name__ == name  # the registry key IS the function name
+        assert fn.__doc__  # each rung documents what it measures
+
+
+@pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="this pins the OFF-chip contract; on TPU the rungs measure",
+)
+def test_rungs_skip_cleanly_on_cpu():
+    for name in EXPECTED:
+        out = tpu_checks.RUNGS[name]()
+        assert isinstance(out, dict), name
+        assert set(out) == {"skipped"}, (name, out)
+        assert "tpu" in out["skipped"].lower(), (name, out)
+
+
+def test_rung_decorator_registers():
+    @tpu_checks.rung
+    def _probe_rung():
+        return {"skipped": "test probe"}
+
+    try:
+        assert tpu_checks.RUNGS["_probe_rung"] is _probe_rung
+    finally:
+        del tpu_checks.RUNGS["_probe_rung"]

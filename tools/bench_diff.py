@@ -47,9 +47,11 @@ def flatten_numeric(tree: Any, prefix: str = "") -> Dict[str, float]:
 
 
 def diff_runs(old: Dict[str, Any], new: Dict[str, Any],
-              tol: float) -> Dict[str, Any]:
+              tol: float, keys=None) -> Dict[str, Any]:
     """Compare the ``parsed`` subtrees; returns ``{"compared", "regressions",
-    "missing_old"/"missing_new" (parsed is null), "added", "removed"}``."""
+    "missing_old"/"missing_new" (parsed is null), "added", "removed"}``.
+    ``keys`` (a sequence of substrings) restricts the comparison to dotted
+    paths containing at least one of them — the ``--keys`` filter."""
     result: Dict[str, Any] = {"compared": 0, "regressions": [],
                               "added": [], "removed": []}
     old_parsed = old.get("parsed")
@@ -60,6 +62,10 @@ def diff_runs(old: Dict[str, Any], new: Dict[str, Any],
         return result
     a = flatten_numeric(old_parsed)
     b = flatten_numeric(new_parsed)
+    if keys:
+        subs = [k for k in keys if k]
+        a = {k: v for k, v in a.items() if any(s in k for s in subs)}
+        b = {k: v for k, v in b.items() if any(s in k for s in subs)}
     result["added"] = sorted(set(b) - set(a))
     result["removed"] = sorted(set(a) - set(b))
     for key in sorted(set(a) & set(b)):
@@ -83,6 +89,10 @@ def main(argv=None) -> int:
     ap.add_argument("new", help="candidate BENCH_r*.json")
     ap.add_argument("--tol", type=float, default=0.10,
                     help="relative drift gate (default 0.10 = ±10%%)")
+    ap.add_argument("--keys", default=None,
+                    help="comma-separated substrings; only dotted paths "
+                         "containing one of them are compared (e.g. "
+                         "--keys gpt_o5,tuned_vs)")
     args = ap.parse_args(argv)
 
     with open(args.old) as f:
@@ -90,7 +100,8 @@ def main(argv=None) -> int:
     with open(args.new) as f:
         new = json.load(f)
 
-    result = diff_runs(old, new, args.tol)
+    keys = args.keys.split(",") if args.keys else None
+    result = diff_runs(old, new, args.tol, keys=keys)
     if result["missing_old"] or result["missing_new"]:
         side = args.old if result["missing_old"] else args.new
         print(f"warning: {side} has parsed=null (run died before its metric "
